@@ -1,0 +1,21 @@
+from multidisttorch_tpu.parallel.cluster import (
+    ProcessEnv,
+    coordinator_address,
+    detect_process_env,
+    find_ifname,
+    initialize_runtime,
+    parse_slurm_nodelist,
+    process_world,
+)
+from multidisttorch_tpu.parallel.collectives import (
+    group_all_gather,
+    group_pmean,
+    group_psum,
+)
+from multidisttorch_tpu.parallel.mesh import (
+    DATA_AXIS,
+    TrialMesh,
+    device_world,
+    global_mesh,
+    setup_groups,
+)

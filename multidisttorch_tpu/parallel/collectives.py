@@ -1,0 +1,88 @@
+"""Group-scoped collectives over trial submeshes.
+
+The reference reaches collectives through torch.distributed with a
+``group=`` handle: ``dist.all_gather(..., group=subgroup)``
+(``/root/reference/example-subgroup.py:27,32``) and DDP's implicit
+bucketed gradient all-reduce (``vae-hpo.py:130``). The TPU-native form:
+``jax.shard_map`` over the submesh's ``data`` axis, with
+``jax.lax.all_gather`` / ``psum`` / ``pmean`` compiled by XLA onto ICI.
+Two groups' collectives touch disjoint devices, so they proceed
+concurrently and independently — same contract as the reference's two
+concurrent subgroup gathers, with no NCCL communicator setup.
+
+In most training code you will not call these directly: replicate params
+and shard the batch with ``TrialMesh.{replicated,batch}_sharding`` and
+XLA inserts the gradient reduction itself (the pjit analog of DDP).
+These wrappers exist for explicit collective programming and for parity
+with the reference's demo (`example-subgroup.py`).
+
+Compiled executables are cached per (mesh, op) so repeated calls on a
+hot path (e.g. a per-step psum) trace and compile exactly once.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from multidisttorch_tpu.parallel.mesh import DATA_AXIS, TrialMesh
+
+
+@lru_cache(maxsize=None)
+def _gather_fn(mesh: Mesh):
+    # check_vma=False: the gathered result is device-invariant by
+    # construction, but shard_map's varying-axis inference cannot prove
+    # replication through all_gather.
+    return jax.jit(
+        jax.shard_map(
+            lambda s: jax.lax.all_gather(s, DATA_AXIS, axis=0, tiled=True),
+            mesh=mesh,
+            in_specs=P(DATA_AXIS),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+
+@lru_cache(maxsize=None)
+def _reduce_fn(mesh: Mesh, op: str):
+    reducer = {"psum": jax.lax.psum, "pmean": jax.lax.pmean}[op]
+    # Each member device contributes one row of x; squeeze the per-device
+    # shard's leading dim so the reduced result has shape x.shape[1:].
+    return jax.jit(
+        jax.shard_map(
+            lambda s: reducer(jnp.squeeze(s, axis=0), DATA_AXIS),
+            mesh=mesh,
+            in_specs=P(DATA_AXIS),
+            out_specs=P(),
+        )
+    )
+
+
+def group_all_gather(trial: TrialMesh, x):
+    """All-gather per-device shards within one trial group.
+
+    ``x`` has leading dim == group size (one row per member device, the
+    analog of each rank contributing one tensor). Returns the gathered
+    array, identical on (replicated across) every member device —
+    matching ``dist.all_gather``'s every-rank-gets-all contract
+    (``example-subgroup.py:25-33``).
+    """
+    return _gather_fn(trial.mesh)(x)
+
+
+def group_psum(trial: TrialMesh, x):
+    """Sum per-device shards (leading dim == group size) across the group.
+
+    The explicit form of DDP's gradient all-reduce scoped to a subgroup
+    (``vae-hpo.py:130``). Every member device holds the full sum.
+    """
+    return _reduce_fn(trial.mesh, "psum")(x)
+
+
+def group_pmean(trial: TrialMesh, x):
+    """Mean per-device shards across the group (DDP gradient averaging)."""
+    return _reduce_fn(trial.mesh, "pmean")(x)

@@ -1,0 +1,1 @@
+from multidisttorch_tpu.utils.logging import log0

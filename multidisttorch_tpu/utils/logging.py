@@ -1,0 +1,47 @@
+"""Group-aware logging: exactly one log line per trial group.
+
+Rebuild of ``print0`` (``/root/reference/utils.py:165-174``), which
+prints only on group-rank 0 with a ``[world_rank:group_rank]`` prefix so
+a job with N subgroups emits exactly N lines per logging call site. The
+TPU-native mapping: "group-rank 0" becomes "the process owning the
+group's first device" (in single-controller mode that is always this
+process, so every trial logs exactly once, as before).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Optional
+
+import jax
+
+from multidisttorch_tpu.parallel.mesh import TrialMesh
+
+
+def log0(
+    *args,
+    trial: Optional[TrialMesh] = None,
+    sep: str = " ",
+    file=None,
+) -> bool:
+    """Print once per group; returns whether this process printed.
+
+    With ``trial=None`` only the global process 0 prints (the reference's
+    ``process_group=None`` degradation). With a trial, the process owning
+    the trial's first device prints, prefixed ``[process:group_rank]``
+    exactly as the reference prefixes ``[world_rank:group_rank]``
+    (``utils.py:173-174``) — the printer's group rank is by construction
+    0, so the visible prefix matches the reference's output shape.
+    """
+    out = sys.stdout if file is None else file
+    pid = jax.process_index()
+    if trial is None:
+        if pid != 0:
+            return False
+        print(f"[{pid}:0]", sep.join(map(str, args)), file=out)
+        return True
+    owner = trial.devices[0].process_index
+    if pid != owner:
+        return False
+    print(f"[{pid}:0]", sep.join(map(str, args)), file=out)
+    return True

@@ -1,0 +1,34 @@
+"""Test harness: 8 virtual CPU devices in one process.
+
+The reference has no tests at all (SURVEY.md §4); its de-facto smoke test
+requires an 8-process mpirun/srun launch (``example-subgroup.py:39``).
+The JAX-native analog needs no launcher: force the host platform to
+expose 8 fake CPU devices so submesh carving, per-trial collectives, and
+full HPO runs execute in plain pytest.
+
+Must run before any JAX backend initialization. The environment's
+sitecustomize may pre-import jax with a TPU plugin pinned via
+JAX_PLATFORMS, so we override through jax.config (effective until the
+backend is first used) rather than os.environ alone.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _assert_eight_devices():
+    assert len(jax.devices()) == 8, (
+        "test harness expected 8 virtual CPU devices, got "
+        f"{jax.devices()} — conftest ran too late relative to backend init"
+    )

@@ -1,0 +1,128 @@
+"""Cluster/env detection tests — parity with /root/reference/utils.py:9-144."""
+
+import pytest
+
+from multidisttorch_tpu.parallel.cluster import (
+    ProcessEnv,
+    coordinator_address,
+    detect_process_env,
+    find_ifname,
+    parse_slurm_nodelist,
+    process_world,
+)
+
+
+class TestDetectProcessEnv:
+    def test_openmpi_wins(self):
+        env = {
+            "OMPI_COMM_WORLD_SIZE": "12",
+            "OMPI_COMM_WORLD_RANK": "7",
+            "SLURM_NPROCS": "99",
+            "SLURM_PROCID": "1",
+        }
+        assert detect_process_env(env) == ProcessEnv(12, 7, "openmpi")
+
+    def test_slurm(self):
+        env = {"SLURM_NPROCS": "4", "SLURM_PROCID": "3"}
+        assert detect_process_env(env) == ProcessEnv(4, 3, "slurm")
+
+    def test_tpu_multihost(self):
+        env = {"TPU_WORKER_ID": "2", "TPU_WORKER_HOSTNAMES": "h0,h1,h2,h3"}
+        assert detect_process_env(env) == ProcessEnv(4, 2, "tpu")
+
+    def test_local_fallback(self):
+        # Reference falls back to (1, 0) for sequential runs (utils.py:23-24).
+        assert detect_process_env({}) == ProcessEnv(1, 0, "local")
+
+    def test_rank_zero_openmpi_with_empty_rank_string_falls_through(self):
+        # Reference quirk: getenv truthiness means OMPI rank "" falls through.
+        env = {"OMPI_COMM_WORLD_SIZE": "2", "OMPI_COMM_WORLD_RANK": ""}
+        assert detect_process_env(env).source == "local"
+
+
+class TestParseSlurmNodelist:
+    # Input examples straight from the reference docstring (utils.py:64-67).
+    def test_single_node(self):
+        assert parse_slurm_nodelist("or-condo-g04") == ["or-condo-g04"]
+
+    def test_bracketed(self):
+        assert parse_slurm_nodelist("or-condo-g[05,07-08,13]") == [
+            "or-condo-g05",
+            "or-condo-g07",
+            "or-condo-g08",
+            "or-condo-g13",
+        ]
+
+    def test_multiple_blocks(self):
+        assert parse_slurm_nodelist("or-condo-g[05,07-08,13],or-condo-h[01,12]") == [
+            "or-condo-g05",
+            "or-condo-g07",
+            "or-condo-g08",
+            "or-condo-g13",
+            "or-condo-h01",
+            "or-condo-h12",
+        ]
+
+    def test_zero_padding_preserved(self):
+        # The reference computes a %0Nd format from the range start
+        # (utils.py:81-85); "008-011" keeps 3-digit padding.
+        assert parse_slurm_nodelist("node[008-011]") == [
+            "node008",
+            "node009",
+            "node010",
+            "node011",
+        ]
+
+    def test_mixed_single_and_bracket(self):
+        assert parse_slurm_nodelist("alpha,beta[1-3]") == [
+            "alpha",
+            "beta1",
+            "beta2",
+            "beta3",
+        ]
+
+
+class TestCoordinatorAddress:
+    def test_lsb_hosts_token_1(self):
+        # Summit jsrun: LSB_HOSTS token [1] (utils.py:111-114).
+        env = {"LSB_HOSTS": "batch5 a01n01 a01n01 a01n02"}
+        assert coordinator_address(env) == "a01n01:8889"
+
+    def test_lsb_mcpu_hosts_token_2(self):
+        env = {"LSB_MCPU_HOSTS": "batch5 42 a03n07 42"}
+        assert coordinator_address(env) == "a03n07:8889"
+
+    def test_slurm_nodelist_first_host(self):
+        env = {"SLURM_NODELIST": "or-condo-g[05,07-08]"}
+        assert coordinator_address(env) == "or-condo-g05:8889"
+
+    def test_priority_lsb_over_slurm(self):
+        env = {
+            "LSB_HOSTS": "batch5 summit1 summit1",
+            "SLURM_NODELIST": "cades1",
+        }
+        assert coordinator_address(env) == "summit1:8889"
+
+    def test_default_and_port_override(self):
+        # Reference defaults: 127.0.0.1:8889 (utils.py:108-109).
+        assert coordinator_address({}) == "127.0.0.1:8889"
+        assert coordinator_address({"MASTER_PORT": "1234"}) == "127.0.0.1:1234"
+        assert coordinator_address({}, port=999) == "127.0.0.1:999"
+
+    def test_master_addr_env(self):
+        assert coordinator_address({"MASTER_ADDR": "10.0.0.5"}) == "10.0.0.5:8889"
+
+
+def test_find_ifname_loopback():
+    # Reference usage example: find_ifname("127.0.0.1") -> "lo"/"lo0"/...
+    # (utils.py:40-45). On any Linux box loopback must resolve.
+    pytest.importorskip("psutil")
+    assert find_ifname("127.0.0.1") is not None
+
+
+def test_find_ifname_unknown_returns_none():
+    assert find_ifname("256.256.256.256") is None
+
+
+def test_process_world_single_controller():
+    assert process_world() == (1, 0)

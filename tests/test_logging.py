@@ -1,0 +1,30 @@
+"""log0 tests — parity with /root/reference/utils.py:165-174 (print0)."""
+
+import io
+
+from multidisttorch_tpu.parallel.mesh import setup_groups
+from multidisttorch_tpu.utils.logging import log0
+
+
+def test_one_line_per_group():
+    groups = setup_groups(2)
+    buf = io.StringIO()
+    printed = [log0("epoch done", trial=g, file=buf) for g in groups]
+    # Single-controller: this process owns every group head -> one line each.
+    assert printed == [True, True]
+    lines = buf.getvalue().strip().split("\n")
+    assert len(lines) == 2
+    for line in lines:
+        assert line == "[0:0] epoch done"  # reference prefix shape [world:group]
+
+
+def test_global_mode_prints_once():
+    buf = io.StringIO()
+    assert log0("hello", "world", file=buf) is True
+    assert buf.getvalue() == "[0:0] hello world\n"
+
+
+def test_sep_honored():
+    buf = io.StringIO()
+    log0("a", "b", sep="|", file=buf)
+    assert buf.getvalue() == "[0:0] a|b\n"

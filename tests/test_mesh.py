@@ -1,0 +1,110 @@
+"""Submesh carving tests — parity with /root/reference/utils.py:146-163."""
+
+import jax
+import numpy as np
+import pytest
+
+from multidisttorch_tpu.parallel.mesh import (
+    DATA_AXIS,
+    device_world,
+    global_mesh,
+    setup_groups,
+)
+
+
+def test_device_world():
+    n, first_local = device_world()
+    assert n == 8
+    assert first_local == 0
+
+
+def test_global_mesh_covers_all_devices():
+    mesh = global_mesh()
+    assert mesh.devices.size == 8
+    assert mesh.axis_names == (DATA_AXIS,)
+
+
+class TestSetupGroups:
+    def test_two_groups_contiguous(self):
+        # Reference carving: contiguous blocks [g*k .. g*k+k-1]
+        # (utils.py:156); with world 8 and 2 groups -> [0-3], [4-7],
+        # matching example-subgroup.py:20-23.
+        groups = setup_groups(2)
+        assert [g.global_ranks for g in groups] == [(0, 1, 2, 3), (4, 5, 6, 7)]
+
+    def test_disjoint_and_complete(self):
+        groups = setup_groups(4)
+        all_ranks = [r for g in groups for r in g.global_ranks]
+        assert sorted(all_ranks) == list(range(8))
+        assert len(set(all_ranks)) == 8
+        seen_devices = set()
+        for g in groups:
+            for d in g.devices:
+                assert d not in seen_devices
+                seen_devices.add(d)
+
+    def test_group_size_and_mesh_axis(self):
+        groups = setup_groups(2)
+        for g in groups:
+            assert g.size == 4
+            assert g.mesh.axis_names == (DATA_AXIS,)
+
+    def test_eight_groups_of_one(self):
+        groups = setup_groups(8)
+        assert all(g.size == 1 for g in groups)
+
+    def test_one_group_is_whole_world(self):
+        (g,) = setup_groups(1)
+        assert g.global_ranks == tuple(range(8))
+
+    def test_too_many_groups_raises(self):
+        # Reference asserts world_size >= num_groups (utils.py:150).
+        with pytest.raises(ValueError, match="exceeds number of total"):
+            setup_groups(9)
+
+    def test_non_divisible_raises(self):
+        # Fix of quirk Q5: the reference silently orphans remainder ranks
+        # and the job hangs (utils.py:152, vae-hpo.py:201).
+        with pytest.raises(ValueError, match="orphaned"):
+            setup_groups(3)
+
+    def test_allow_uneven_drops_remainder(self):
+        groups = setup_groups(3, allow_uneven=True)
+        assert all(g.size == 2 for g in groups)
+        covered = {r for g in groups for r in g.global_ranks}
+        assert covered == set(range(6))  # devices 6, 7 deliberately dropped
+
+    def test_membership_single_controller(self):
+        # Every process holds handles to ALL groups (reference contract,
+        # utils.py:163) and tests membership per group (vae-hpo.py:201).
+        groups = setup_groups(2)
+        for g in groups:
+            assert g.is_local_member  # single-controller: owns everything
+            assert g.local_rank == 0
+            assert g.rank_of(g.devices[0]) == 0
+            assert g.rank_of(g.devices[-1]) == g.size - 1
+            # Non-member device has rank -1, like dist.get_rank -> -1.
+            other = groups[1 - g.group_id].devices[0]
+            assert g.rank_of(other) == -1
+
+    def test_zero_groups_raises(self):
+        with pytest.raises(ValueError, match=">= 1"):
+            setup_groups(0)
+
+    def test_carving_is_metadata_only_fast(self):
+        # Q2: no collective handshake — carving 8 groups must be
+        # instantaneous (no compilation, no device sync).
+        import time
+
+        t0 = time.perf_counter()
+        for _ in range(50):
+            setup_groups(8)
+        assert time.perf_counter() - t0 < 2.0
+
+    def test_shardings(self):
+        g0, _ = setup_groups(2)
+        x = np.arange(8, dtype=np.float32).reshape(4, 2)
+        xs = jax.device_put(x, g0.batch_sharding)
+        assert xs.sharding.mesh == g0.mesh
+        params = g0.device_put({"w": np.ones((3,), np.float32)})
+        np.testing.assert_array_equal(np.asarray(params["w"]), np.ones(3))
