@@ -1,0 +1,137 @@
+"""Benchmark: VAE training samples/sec/chip vs the reference implementation.
+
+Measures the flagship workload (MNIST-shaped VAE, batch 128 — the
+reference's defaults, /root/reference/vae-hpo.py:131,183) as a
+jit-compiled train step on the available accelerator, against the
+reference's torch train loop executed in-process on CPU (the only
+hardware its stack can use here; the reference publishes no numbers of
+its own — see BASELINE.md).
+
+Prints exactly ONE JSON line:
+  {"metric": "vae_train_samples_per_sec_per_chip", "value": ...,
+   "unit": "samples/sec/chip", "vs_baseline": ...}
+
+vs_baseline = our throughput / reference-loop throughput.
+"""
+
+import json
+import sys
+import time
+import warnings
+
+warnings.filterwarnings("ignore")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+BATCH = 128
+HIDDEN, LATENT = 400, 20
+WARMUP_STEPS = 10
+MEASURE_STEPS = 200
+TORCH_MEASURE_STEPS = 30
+
+
+def bench_ours() -> float:
+    from multidisttorch_tpu.models.vae import VAE
+    from multidisttorch_tpu.parallel.mesh import setup_groups
+    from multidisttorch_tpu.train.steps import create_train_state, make_train_step
+
+    ndev = len(jax.devices())
+    (trial,) = setup_groups(1)
+    # bfloat16 matmuls on the MXU, float32 params/loss — the TPU-first
+    # configuration; on CPU runs it silently behaves like float32.
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    model = VAE(hidden_dim=HIDDEN, latent_dim=LATENT, dtype=dtype)
+    tx = optax.adam(1e-3)
+    state = create_train_state(trial, model, tx, jax.random.key(0))
+    step = make_train_step(trial, model, tx)
+
+    batch_np = (
+        np.random.default_rng(0).uniform(0, 1, (BATCH, 784)).astype(np.float32)
+    )
+    batch = jax.device_put(jnp.asarray(batch_np), trial.batch_sharding)
+    key = jax.random.key(1)
+
+    for i in range(WARMUP_STEPS):
+        state, m = step(state, batch, jax.random.fold_in(key, i))
+    jax.block_until_ready(state.params)
+
+    t0 = time.perf_counter()
+    for i in range(MEASURE_STEPS):
+        state, m = step(state, batch, jax.random.fold_in(key, WARMUP_STEPS + i))
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+    return MEASURE_STEPS * BATCH / dt / ndev
+
+
+def bench_reference_torch() -> float:
+    """The reference's train inner loop (vae-hpo.py:61-74) on torch CPU."""
+    import torch
+    import torch.nn.functional as F
+    from torch import nn, optim
+
+    torch.manual_seed(0)
+
+    class VAE(nn.Module):
+        # Architecture per /root/reference/vae-hpo.py:19-45.
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(784, HIDDEN)
+            self.fc21 = nn.Linear(HIDDEN, LATENT)
+            self.fc22 = nn.Linear(HIDDEN, LATENT)
+            self.fc3 = nn.Linear(LATENT, HIDDEN)
+            self.fc4 = nn.Linear(HIDDEN, 784)
+
+        def forward(self, x):
+            h = F.relu(self.fc1(x))
+            mu, logvar = self.fc21(h), self.fc22(h)
+            std = torch.exp(0.5 * logvar)
+            z = mu + torch.randn_like(std) * std
+            recon = torch.sigmoid(self.fc4(F.relu(self.fc3(z))))
+            return recon, mu, logvar
+
+    model = VAE()
+    opt = optim.Adam(model.parameters(), lr=1e-3)
+    data = torch.rand(BATCH, 784)
+
+    def one_step():
+        opt.zero_grad()
+        recon, mu, logvar = model(data)
+        bce = F.binary_cross_entropy(recon, data, reduction="sum")
+        kld = -0.5 * torch.sum(1 + logvar - mu.pow(2) - logvar.exp())
+        (bce + kld).backward()
+        opt.step()
+
+    for _ in range(3):
+        one_step()
+    t0 = time.perf_counter()
+    for _ in range(TORCH_MEASURE_STEPS):
+        one_step()
+    dt = time.perf_counter() - t0
+    return TORCH_MEASURE_STEPS * BATCH / dt
+
+
+def main():
+    ours = bench_ours()
+    try:
+        ref = bench_reference_torch()
+    except Exception as e:
+        print(f"reference torch bench failed: {e!r}", file=sys.stderr)
+        ref = float("nan")
+    vs = ours / ref if ref == ref and ref > 0 else float("nan")
+    print(
+        json.dumps(
+            {
+                "metric": "vae_train_samples_per_sec_per_chip",
+                "value": round(ours, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(vs, 3) if vs == vs else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,8 @@
+from multidisttorch_tpu.data.datasets import (
+    Dataset,
+    load_cifar10,
+    load_mnist,
+    synthetic_cifar10,
+    synthetic_mnist,
+)
+from multidisttorch_tpu.data.sampler import TrialDataIterator

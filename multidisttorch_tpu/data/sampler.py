@@ -1,0 +1,98 @@
+"""Trial-aware data sampling and device feeding.
+
+Rebuild of the reference's sampler/loader layer
+(``torch.utils.data.DistributedSampler`` + ``DataLoader``,
+``/root/reference/vae-hpo.py:146-158``), with two deliberate fixes from
+SURVEY.md §2d:
+
+- **Q1**: the reference shards the dataset *across trials*
+  (``DistributedSampler(rank=group_id, num_replicas=ngroups)``) and
+  feeds every rank inside a group the identical shard — redundant
+  compute, and each trial sees only 1/ngroups of the data. Here the
+  default is the full dataset per trial, sharded *within* the submesh by
+  the batch sharding; ``shard_across_trials=True`` reproduces the
+  reference behavior for comparability.
+- **Q6**: the reference never reshuffles (``shuffle=False``, no
+  ``set_epoch``). Here every epoch draws a fresh seeded permutation,
+  deterministic per (seed, epoch).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+
+from multidisttorch_tpu.data.datasets import Dataset
+from multidisttorch_tpu.parallel.mesh import TrialMesh
+
+
+class TrialDataIterator:
+    """Per-trial epoch iterator yielding device-ready sharded batches.
+
+    Yields trial-global batches of ``batch_size`` rows placed with the
+    trial's batch sharding (dim 0 split over the submesh data axis), so
+    the jit'd train step consumes them with zero reshards. Incomplete
+    trailing batches are dropped (static shapes keep XLA to one
+    executable — a TPU-first requirement, not an optimization).
+    """
+
+    def __init__(
+        self,
+        dataset: Dataset,
+        trial: TrialMesh,
+        batch_size: int,
+        *,
+        seed: int = 0,
+        shard_across_trials: bool = False,
+        num_trials: Optional[int] = None,
+        drop_remainder: bool = True,
+        with_labels: bool = False,
+    ):
+        if batch_size % trial.size != 0:
+            raise ValueError(
+                f"batch_size {batch_size} must divide evenly over the "
+                f"trial's {trial.size} devices (static per-device shapes)"
+            )
+        self.dataset = dataset
+        self.trial = trial
+        self.batch_size = batch_size
+        self.seed = seed
+        self.with_labels = with_labels
+        if shard_across_trials:
+            # Legacy Q1 semantics: trial g sees rows [g::num_trials].
+            if num_trials is None:
+                raise ValueError("shard_across_trials requires num_trials")
+            self._indices = np.arange(len(dataset))[trial.group_id::num_trials]
+        else:
+            self._indices = np.arange(len(dataset))
+        self.num_batches = len(self._indices) // batch_size
+        if self.num_batches == 0:
+            raise ValueError(
+                f"dataset shard of {len(self._indices)} rows smaller than "
+                f"one batch of {batch_size}"
+            )
+
+    def epoch(self, epoch: int) -> Iterator:
+        """Iterate one epoch with a fresh (seed, epoch) permutation."""
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, epoch])
+        )
+        perm = rng.permutation(self._indices)
+        for b in range(self.num_batches):
+            idx = perm[b * self.batch_size : (b + 1) * self.batch_size]
+            imgs = jax.device_put(
+                self.dataset.images[idx], self.trial.batch_sharding
+            )
+            if self.with_labels:
+                labels = jax.device_put(
+                    self.dataset.labels[idx], self.trial.batch_sharding
+                )
+                yield imgs, labels
+            else:
+                yield imgs
+
+    @property
+    def samples_per_epoch(self) -> int:
+        return self.num_batches * self.batch_size

@@ -1,0 +1,1 @@
+from multidisttorch_tpu.hpo.driver import TrialConfig, TrialResult, run_hpo

@@ -1,0 +1,1 @@
+from multidisttorch_tpu.models.vae import VAE, init_vae_params

@@ -1,0 +1,84 @@
+"""MLP VAE for MNIST — the reference's flagship workload model.
+
+Same architecture as ``/root/reference/vae-hpo.py:19-45`` (encoder
+784→400→(20 mu, 20 logvar), decoder 20→400→784), re-designed for TPU:
+
+- the decoder returns **logits** (the sigmoid lives inside the
+  numerically-stable loss, ``ops/losses.py``; call
+  :meth:`VAE.decode_probs` when you need images);
+- a ``dtype`` knob runs the matmuls in bfloat16 on the MXU while keeping
+  parameters in float32 (``param_dtype``);
+- reparameterization noise comes from an explicit flax RNG stream
+  (``'reparam'``) so trials are reproducible per-seed and XLA can
+  partition sampling across the data axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class VAE(nn.Module):
+    """MLP VAE: 784-400-(latent) encoder, (latent)-400-784 decoder.
+
+    Defaults match the reference exactly (hidden 400, latent 20 —
+    ``vae-hpo.py:23-27``); they are module fields so the HPO driver can
+    sweep them (the reference hard-codes them).
+    """
+
+    input_dim: int = 784
+    hidden_dim: int = 400
+    latent_dim: int = 20
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        dense = lambda feats, name: nn.Dense(
+            feats, dtype=self.dtype, param_dtype=jnp.float32, name=name
+        )
+        self.fc1 = dense(self.hidden_dim, "fc1")
+        self.fc21 = dense(self.latent_dim, "fc21")
+        self.fc22 = dense(self.latent_dim, "fc22")
+        self.fc3 = dense(self.hidden_dim, "fc3")
+        self.fc4 = dense(self.input_dim, "fc4")
+
+    def encode(self, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """Flatten and encode to (mu, logvar) — ``vae-hpo.py:29-31,43``."""
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        h1 = nn.relu(self.fc1(x))
+        return self.fc21(h1), self.fc22(h1)
+
+    def reparameterize(self, mu, logvar):
+        """``z = mu + eps * exp(0.5*logvar)`` with eps ~ N(0, I)
+        (``vae-hpo.py:33-36``), eps drawn from the 'reparam' RNG stream."""
+        eps = jax.random.normal(
+            self.make_rng("reparam"), mu.shape, dtype=jnp.float32
+        ).astype(mu.dtype)
+        return mu + eps * jnp.exp(0.5 * logvar)
+
+    def decode(self, z: jnp.ndarray) -> jnp.ndarray:
+        """Decode to **logits** over pixels (reference applies sigmoid
+        here, ``vae-hpo.py:38-40``; we defer it to the loss/image path)."""
+        h3 = nn.relu(self.fc3(z.astype(self.dtype)))
+        return self.fc4(h3)
+
+    def decode_probs(self, z: jnp.ndarray) -> jnp.ndarray:
+        """Decode to pixel probabilities (the reference's decode output)."""
+        return nn.sigmoid(self.decode(z))
+
+    def __call__(self, x: jnp.ndarray):
+        """Returns ``(recon_logits, mu, logvar)`` — the reference's
+        ``forward`` contract (``vae-hpo.py:42-45``) with logits instead
+        of probabilities."""
+        mu, logvar = self.encode(x)
+        z = self.reparameterize(mu, logvar)
+        return self.decode(z), mu, logvar
+
+
+def init_vae_params(rng: jax.Array, model: VAE, batch_size: int = 1):
+    """Initialize parameters with a dummy batch (flax idiom)."""
+    dummy = jnp.zeros((batch_size, model.input_dim), jnp.float32)
+    return model.init({"params": rng, "reparam": rng}, dummy)

@@ -1,0 +1,6 @@
+from multidisttorch_tpu.ops.losses import (
+    bernoulli_recon_sum,
+    elbo_loss_sum,
+    gaussian_kl_sum,
+    softmax_cross_entropy_mean,
+)

@@ -199,13 +199,27 @@ def initialize_runtime(
     if penv.num_processes > 1:
         import jax
 
-        if coordinator is None and penv.source == "tpu":
+        env = os.environ if environ is None else environ
+        env_elects_master = any(
+            env.get(k) is not None
+            for k in ("LSB_HOSTS", "LSB_MCPU_HOSTS", "SLURM_NODELIST", "MASTER_ADDR")
+        )
+        if coordinator is None and penv.source == "tpu" and not env_elects_master:
             # Cloud TPU pods publish coordinator metadata JAX already
             # knows how to read; none of the reference's master-election
             # env vars (LSB_*/SLURM_*/MASTER_ADDR) exist there, so the
             # elected fallback would be 127.0.0.1 — wrong on every
             # non-zero worker. Let JAX autodetect instead.
             jax.distributed.initialize()
+        elif coordinator is None and penv.source == "jax" and not env_elects_master:
+            # Generic JAX coordinates: respect JAX_COORDINATOR_ADDRESS
+            # (JAX reads it only when coordinator_address is None) rather
+            # than electing a 127.0.0.1 fallback on every worker.
+            jax.distributed.initialize(
+                coordinator_address=None,
+                num_processes=penv.num_processes,
+                process_id=penv.process_id,
+            )
         else:
             jax.distributed.initialize(
                 coordinator_address=(
@@ -221,12 +235,17 @@ def initialize_runtime(
 
 
 def process_world() -> tuple[int, int]:
-    """Post-init process count and index, ``(size, rank)``.
+    """Process count and index, ``(size, rank)``.
 
     Analog of ``get_comm_size_and_rank`` (``/root/reference/
-    utils.py:28-38``): reads the live runtime if one exists, else
-    ``(1, 0)``.
+    utils.py:28-38``). Unlike torch's side-effect-free
+    ``dist.is_initialized()`` probe, querying JAX's process coordinates
+    initializes the XLA backend — which would poison a later
+    ``jax.distributed.initialize``. So this calls
+    :func:`initialize_runtime` first (idempotent), making it safe in any
+    order, exactly like the reference's query.
     """
     import jax
 
+    initialize_runtime()
     return jax.process_count(), jax.process_index()

@@ -1,0 +1,44 @@
+"""Trial-state checkpoint/resume.
+
+The reference persists nothing but PNGs (SURVEY.md §5 — no
+``torch.save`` anywhere); checkpointing is an explicit upgrade required
+by the PBT config (BASELINE.md config 5), which moves trial weights
+between submeshes. State is a plain pytree (``train.steps.TrainState``),
+serialized with flax's msgpack codec; restore re-places it onto any
+target submesh — the same mechanism serves disk checkpoints and
+inter-trial weight broadcast.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+from flax import serialization
+
+from multidisttorch_tpu.parallel.mesh import TrialMesh
+
+
+def save_state(state: Any, path: str, *, metadata: Optional[dict] = None) -> str:
+    """Serialize a state pytree (host-side) to ``path`` (msgpack)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    host_state = jax.device_get(state)
+    with open(path, "wb") as f:
+        f.write(serialization.to_bytes(host_state))
+    if metadata is not None:
+        with open(path + ".json", "w") as f:
+            json.dump(metadata, f, indent=2, default=str)
+    return path
+
+
+def restore_state(template: Any, path: str, trial: Optional[TrialMesh] = None) -> Any:
+    """Restore into the structure of ``template``; optionally place
+    replicated onto ``trial``'s submesh (checkpoint-restart or PBT
+    exploit onto a different device group)."""
+    with open(path, "rb") as f:
+        restored = serialization.from_bytes(jax.device_get(template), f.read())
+    if trial is not None:
+        restored = trial.device_put(restored)
+    return restored

@@ -1,0 +1,125 @@
+"""Data pipeline tests: datasets, sampler semantics (Q1/Q6 fixes)."""
+
+import numpy as np
+import pytest
+
+from multidisttorch_tpu.data.datasets import (
+    load_mnist,
+    synthetic_cifar10,
+    synthetic_mnist,
+)
+from multidisttorch_tpu.data.sampler import TrialDataIterator
+from multidisttorch_tpu.parallel.mesh import setup_groups
+
+
+def test_synthetic_mnist_deterministic():
+    a = synthetic_mnist(100, seed=0)
+    b = synthetic_mnist(100, seed=0)
+    np.testing.assert_array_equal(a.images, b.images)
+    assert a.images.shape == (100, 784)
+    assert a.images.min() >= 0.0 and a.images.max() <= 1.0
+    assert a.synthetic
+
+
+def test_synthetic_classes_distinguishable():
+    ds = synthetic_mnist(500, seed=0)
+    # class means must differ (classifier/VAE can learn structure)
+    m0 = ds.images[ds.labels == 0].mean(axis=0)
+    m5 = ds.images[ds.labels == 5].mean(axis=0)
+    assert np.abs(m0 - m5).max() > 0.05
+
+
+def test_load_mnist_falls_back_to_synthetic(tmp_path):
+    ds = load_mnist(train=True, data_dir=str(tmp_path), synthetic_size=256)
+    assert len(ds) == 256
+    assert ds.images.shape == (256, 784)
+
+
+def test_load_mnist_idx_roundtrip(tmp_path):
+    # Write a tiny IDX pair and check the parser path (the real-MNIST path).
+    import struct
+
+    imgs = (np.arange(4 * 28 * 28) % 256).astype(np.uint8).reshape(4, 28, 28)
+    labels = np.array([3, 1, 4, 1], np.uint8)
+    with open(tmp_path / "train-images-idx3-ubyte", "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 3))
+        f.write(struct.pack(">III", 4, 28, 28))
+        f.write(imgs.tobytes())
+    with open(tmp_path / "train-labels-idx1-ubyte", "wb") as f:
+        f.write(struct.pack(">HBB", 0, 0x08, 1))
+        f.write(struct.pack(">I", 4))
+        f.write(labels.tobytes())
+    ds = load_mnist(train=True, data_dir=str(tmp_path))
+    assert ds.name == "mnist"
+    assert not ds.synthetic
+    assert ds.images.shape == (4, 784)
+    np.testing.assert_allclose(ds.images.max(), 255 / 255.0)
+    np.testing.assert_array_equal(ds.labels, [3, 1, 4, 1])
+
+
+def test_synthetic_cifar_shape():
+    ds = synthetic_cifar10(64, seed=0)
+    assert ds.images.shape == (64, 32 * 32 * 3)
+
+
+class TestTrialDataIterator:
+    def test_batches_sharded_on_submesh(self):
+        trial = setup_groups(2)[0]
+        ds = synthetic_mnist(256, seed=0)
+        it = TrialDataIterator(ds, trial, batch_size=32, seed=0)
+        batch = next(iter(it.epoch(0)))
+        assert batch.shape == (32, 784)
+        assert batch.sharding.mesh == trial.mesh  # lands pre-sharded
+
+    def test_epoch_reshuffle_fixes_q6(self):
+        # Q6: reference iterates identical order every epoch. We must not.
+        trial = setup_groups(8)[0]
+        ds = synthetic_mnist(64, seed=0)
+        it = TrialDataIterator(ds, trial, batch_size=16, seed=0)
+        e0 = np.asarray(next(iter(it.epoch(0))))
+        e1 = np.asarray(next(iter(it.epoch(1))))
+        e0_again = np.asarray(next(iter(it.epoch(0))))
+        assert not np.array_equal(e0, e1)  # different epochs differ
+        np.testing.assert_array_equal(e0, e0_again)  # same epoch reproducible
+
+    def test_full_dataset_per_trial_by_default_fixes_q1(self):
+        trial = setup_groups(2)[0]
+        ds = synthetic_mnist(128, seed=0)
+        it = TrialDataIterator(ds, trial, batch_size=32, seed=0)
+        assert it.samples_per_epoch == 128  # whole dataset, not 1/ngroups
+
+    def test_legacy_cross_trial_sharding(self):
+        # Reference behavior (Q1): trial g sees 1/ngroups of the data.
+        groups = setup_groups(2)
+        ds = synthetic_mnist(128, seed=0)
+        its = [
+            TrialDataIterator(
+                ds, g, batch_size=32, shard_across_trials=True, num_trials=2
+            )
+            for g in groups
+        ]
+        assert all(it.samples_per_epoch == 64 for it in its)
+        # shards are disjoint
+        rows0 = {tuple(r) for b in its[0].epoch(0) for r in np.asarray(b)}
+        rows1 = {tuple(r) for b in its[1].epoch(0) for r in np.asarray(b)}
+        assert not rows0 & rows1
+
+    def test_batch_must_divide_devices(self):
+        trial = setup_groups(2)[0]  # 4 devices
+        ds = synthetic_mnist(64, seed=0)
+        with pytest.raises(ValueError, match="divide evenly"):
+            TrialDataIterator(ds, trial, batch_size=30)
+
+    def test_dataset_smaller_than_batch_raises(self):
+        trial = setup_groups(8)[0]
+        ds = synthetic_mnist(8, seed=0)
+        with pytest.raises(ValueError, match="smaller than"):
+            TrialDataIterator(ds, trial, batch_size=16)
+
+    def test_with_labels(self):
+        trial = setup_groups(8)[1]
+        ds = synthetic_mnist(64, seed=0)
+        it = TrialDataIterator(ds, trial, batch_size=16, with_labels=True)
+        imgs, labels = next(iter(it.epoch(0)))
+        assert imgs.shape == (16, 784)
+        assert labels.shape == (16,)
