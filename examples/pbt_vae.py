@@ -1,0 +1,52 @@
+"""Population-based training of VAEs across submeshes (BASELINE.md
+config 5: "inter-subgroup weight broadcast/exploit across submeshes").
+
+Run (8 virtual CPU devices, population of 4 on 2-device submeshes):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/pbt_vae.py --population 4 --generations 3
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import multidisttorch_tpu as mdt  # noqa: E402
+from multidisttorch_tpu.data import load_mnist  # noqa: E402
+from multidisttorch_tpu.hpo import PBTConfig, run_pbt  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser(description="PBT VAE (TPU-native)")
+    parser.add_argument("--population", type=int, default=4)
+    parser.add_argument("--generations", type=int, default=3)
+    parser.add_argument("--steps-per-generation", type=int, default=50)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--out-dir", default="results-pbt")
+    parser.add_argument("--synthetic-size", type=int, default=None)
+    args = parser.parse_args()
+
+    mdt.initialize_runtime()
+    train_data = load_mnist(train=True, synthetic_size=args.synthetic_size)
+    eval_data = load_mnist(
+        train=False,
+        synthetic_size=args.synthetic_size and max(args.batch_size, args.synthetic_size // 6),
+    )
+
+    cfg = PBTConfig(
+        population=args.population,
+        generations=args.generations,
+        steps_per_generation=args.steps_per_generation,
+        batch_size=args.batch_size,
+    )
+    result = run_pbt(cfg, train_data, eval_data, out_dir=args.out_dir)
+    print(
+        f"best member {result.best_member}: eval loss "
+        f"{result.best_eval_loss:.2f}; final lrs "
+        f"{['%.1e' % lr for lr in result.final_lrs]}; "
+        f"wall {result.wall_s:.1f}s"
+    )
+
+
+if __name__ == "__main__":
+    main()

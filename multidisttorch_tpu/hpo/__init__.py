@@ -1,1 +1,2 @@
 from multidisttorch_tpu.hpo.driver import TrialConfig, TrialResult, run_hpo
+from multidisttorch_tpu.hpo.pbt import PBTConfig, PBTResult, run_pbt

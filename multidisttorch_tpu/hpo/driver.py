@@ -102,6 +102,7 @@ class _TrialRun:
         save_images: bool = True,
         save_checkpoint: bool = True,
         verbose: bool = True,
+        model_builder=None,
     ):
         self.trial = trial
         self.cfg = cfg
@@ -117,7 +118,10 @@ class _TrialRun:
         self._verbose = verbose
         self._test_data = test_data
 
-        model = VAE(hidden_dim=cfg.hidden_dim, latent_dim=cfg.latent_dim)
+        if model_builder is None:
+            model = VAE(hidden_dim=cfg.hidden_dim, latent_dim=cfg.latent_dim)
+        else:
+            model = model_builder(cfg)
         tx = optax.adam(cfg.lr)
         self.model, self.tx = model, tx
         self.state = create_train_state(
@@ -262,6 +266,7 @@ def run_hpo(
     save_images: bool = True,
     save_checkpoints: bool = True,
     verbose: bool = True,
+    model_builder=None,
 ) -> list[TrialResult]:
     """Run one trial per config, each on its own disjoint submesh,
     concurrently, with no cross-trial synchronization.
@@ -269,7 +274,10 @@ def run_hpo(
     ``groups`` defaults to ``setup_groups(len(configs))`` over all
     devices. Trials whose submesh has no local devices are skipped on
     this process (multi-controller membership, ``vae-hpo.py:200-202``).
-    Returns results for locally-run trials, in config order.
+    ``model_builder(cfg)`` swaps the model family (e.g. ``ConvVAE`` for
+    the β-VAE CIFAR config) while reusing all scaffolding; default is
+    the flagship MLP VAE. Returns results for locally-run trials, in
+    config order.
     """
     if groups is None:
         groups = setup_groups(len(configs))
@@ -290,6 +298,7 @@ def run_hpo(
             save_images=save_images,
             save_checkpoint=save_checkpoints,
             verbose=verbose,
+            model_builder=model_builder,
         )
         for trial, cfg in zip(groups, configs)
         if trial.is_local_member

@@ -1,0 +1,256 @@
+"""Population-based training across trial submeshes (BASELINE.md
+config 5: "inter-subgroup weight broadcast/exploit across submeshes").
+
+The reference's north-star extension: instead of N independent HPO
+trials (``/root/reference/vae-hpo.py:200-202``), the N subgroups form a
+*population* — periodically the worst trials clone the best trials'
+weights (exploit) and perturb their hyperparameters (explore). In the
+torch design this would need inter-group NCCL broadcasts negotiated
+across communicators; here a cross-submesh weight move is a host-side
+``device_put`` of a replicated pytree onto the target submesh — no
+collective choreography at all.
+
+The learning rate lives inside the optimizer state via
+``optax.inject_hyperparams``, so exploit/explore mutates it without
+recompiling the member's train step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from multidisttorch_tpu.data.datasets import Dataset
+from multidisttorch_tpu.data.sampler import TrialDataIterator
+from multidisttorch_tpu.models.vae import VAE
+from multidisttorch_tpu.parallel.mesh import TrialMesh, setup_groups
+from multidisttorch_tpu.train.steps import (
+    TrainState,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
+from multidisttorch_tpu.utils.logging import log0
+
+
+@dataclass(frozen=True)
+class PBTConfig:
+    population: int = 4
+    generations: int = 5
+    steps_per_generation: int = 30
+    batch_size: int = 64
+    lr_min: float = 1e-4
+    lr_max: float = 1e-2
+    beta: float = 1.0
+    exploit_fraction: float = 0.25  # bottom q exploits top q
+    perturb_factors: tuple[float, float] = (0.8, 1.25)
+    seed: int = 0
+    hidden_dim: int = 400
+    latent_dim: int = 20
+
+
+@dataclass
+class PBTResult:
+    best_member: int
+    best_eval_loss: float
+    history: list = field(default_factory=list)  # per-generation dicts
+    final_lrs: list = field(default_factory=list)
+    wall_s: float = 0.0
+
+
+def _set_lr(state: TrainState, lr: float) -> TrainState:
+    """Overwrite the injected learning rate inside the optimizer state."""
+    opt = state.opt_state
+    hp = dict(opt.hyperparams)
+    hp["learning_rate"] = jnp.asarray(lr, dtype=hp["learning_rate"].dtype)
+    return state.replace(opt_state=opt._replace(hyperparams=hp))
+
+
+class _Member:
+    def __init__(
+        self,
+        trial: TrialMesh,
+        member_id: int,
+        cfg: PBTConfig,
+        model: VAE,
+        train_data: Dataset,
+        eval_data: Dataset,
+        lr: float,
+    ):
+        self.trial = trial
+        self.member_id = member_id
+        self.lr = lr
+        tx = optax.inject_hyperparams(optax.adam)(learning_rate=lr)
+        self.state = create_train_state(
+            trial, model, tx, jax.random.key(cfg.seed + member_id)
+        )
+        self.train_step = make_train_step(trial, model, tx, beta=cfg.beta)
+        self.eval_step = make_eval_step(
+            trial, model, beta=cfg.beta, with_recon=False
+        )
+        self.train_iter = TrialDataIterator(
+            train_data, trial, cfg.batch_size, seed=cfg.seed + member_id
+        )
+        # eval batch must keep the per-device divisibility invariant
+        eval_bs = min(cfg.batch_size, len(eval_data))
+        eval_bs -= eval_bs % trial.size
+        if eval_bs == 0:
+            raise ValueError(
+                f"eval set of {len(eval_data)} rows too small for a "
+                f"{trial.size}-device submesh"
+            )
+        self.eval_iter = TrialDataIterator(eval_data, trial, eval_bs, seed=0)
+        self._epoch = 0
+        self._batches = iter(())
+        self._key = jax.random.key(1000 + member_id)
+        self._step = 0
+
+    def next_batch(self):
+        try:
+            return next(self._batches)
+        except StopIteration:
+            self._batches = self.train_iter.epoch(self._epoch)
+            self._epoch += 1
+            return next(self._batches)
+
+    def one_step(self):
+        rng = jax.random.fold_in(self._key, self._step)
+        self.state, m = self.train_step(self.state, self.next_batch(), rng)
+        self._step += 1
+        return m
+
+    def eval_loss(self) -> float:
+        total, n = 0.0, 0
+        for batch in self.eval_iter.epoch(0):
+            out = self.eval_step(self.state, batch)
+            total += float(out["loss_sum"])
+            n += batch.shape[0]
+        return total / n
+
+
+def run_pbt(
+    cfg: PBTConfig,
+    train_data: Dataset,
+    eval_data: Dataset,
+    *,
+    groups: Optional[Sequence[TrialMesh]] = None,
+    out_dir: Optional[str] = None,
+    verbose: bool = True,
+) -> PBTResult:
+    """Run synchronous-generation PBT, one member per submesh.
+
+    Within a generation, members' train steps are dispatched round-robin
+    (all submeshes busy concurrently); the exploit/explore exchange at
+    generation boundaries is the only cross-trial coordination — and it
+    is host-side metadata + one device_put per exploited member.
+    """
+    if jax.process_count() > 1:
+        raise NotImplementedError(
+            "run_pbt currently requires single-controller mode: the "
+            "exploit step fetches remote submesh states with device_get, "
+            "which cannot address devices owned by other processes. "
+            "Multi-host PBT needs a cross-process transfer "
+            "(multihost_utils.broadcast) — planned."
+        )
+    if groups is None:
+        groups = setup_groups(cfg.population)
+    if len(groups) != cfg.population:
+        raise ValueError(
+            f"population {cfg.population} but {len(groups)} device groups"
+        )
+
+    model = VAE(hidden_dim=cfg.hidden_dim, latent_dim=cfg.latent_dim)
+    rng = np.random.default_rng(cfg.seed)
+    init_lrs = np.exp(
+        rng.uniform(np.log(cfg.lr_min), np.log(cfg.lr_max), cfg.population)
+    )
+    members = [
+        _Member(g, i, cfg, model, train_data, eval_data, float(init_lrs[i]))
+        for i, g in enumerate(groups)
+    ]
+
+    # clamp to half the population so the top and bottom slices can never
+    # overlap (an overlapping slice would let an exploiter clone a state
+    # that was itself just overwritten in the same exchange)
+    n_exploit = max(1, int(np.floor(cfg.exploit_fraction * cfg.population)))
+    n_exploit = min(n_exploit, cfg.population // 2)
+    result = PBTResult(best_member=-1, best_eval_loss=float("inf"))
+    t0 = time.time()
+
+    for gen in range(cfg.generations):
+        # --- explore phase: interleaved dispatch keeps all submeshes busy
+        for _ in range(cfg.steps_per_generation):
+            for m in members:
+                m.one_step()
+
+        scores = {m.member_id: m.eval_loss() for m in members}
+        ranked = sorted(members, key=lambda m: scores[m.member_id])
+        record = {
+            "generation": gen,
+            "scores": {m.member_id: scores[m.member_id] for m in ranked},
+            "lrs": {m.member_id: m.lr for m in members},
+            "exploits": [],
+        }
+
+        # --- exploit/explore: bottom n_exploit copy a top-n_exploit peer
+        top, bottom = ranked[:n_exploit], ranked[-n_exploit:]
+        for i, bad in enumerate(bottom):
+            good = top[i % len(top)]
+            if scores[bad.member_id] <= scores[good.member_id]:
+                continue
+            # cross-submesh weight + optimizer-state transfer: fetch the
+            # winner's replicated state, place it onto the loser's mesh
+            cloned = bad.trial.device_put(jax.device_get(good.state))
+            factor = float(rng.choice(cfg.perturb_factors))
+            new_lr = float(
+                np.clip(good.lr * factor, cfg.lr_min, cfg.lr_max)
+            )
+            bad.state = _set_lr(cloned, new_lr)
+            bad.lr = new_lr
+            record["exploits"].append(
+                {
+                    "from": good.member_id,
+                    "to": bad.member_id,
+                    "new_lr": new_lr,
+                }
+            )
+            if verbose:
+                log0(
+                    f"PBT gen {gen}: member {bad.member_id} "
+                    f"(loss {scores[bad.member_id]:.2f}) exploits "
+                    f"{good.member_id} (loss {scores[good.member_id]:.2f}), "
+                    f"lr -> {new_lr:.2e}",
+                    trial=bad.trial,
+                )
+
+        result.history.append(record)
+        best = ranked[0]
+        if scores[best.member_id] < result.best_eval_loss:
+            result.best_eval_loss = scores[best.member_id]
+            result.best_member = best.member_id
+
+    result.wall_s = time.time() - t0
+    result.final_lrs = [m.lr for m in members]
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "pbt.json"), "w") as f:
+            json.dump(
+                {
+                    "best_member": result.best_member,
+                    "best_eval_loss": result.best_eval_loss,
+                    "final_lrs": result.final_lrs,
+                    "history": result.history,
+                    "wall_s": result.wall_s,
+                },
+                f,
+                indent=2,
+            )
+    return result

@@ -1,0 +1,102 @@
+"""Convolutional β-VAE for CIFAR-10 (BASELINE.md config 3).
+
+The reference has no conv model — its stretch configs (BASELINE.json)
+call for a β-VAE on CIFAR-10 stressing per-trial all-reduce with a
+larger parameter volume. TPU-first choices: strided convs (MXU-friendly,
+no pooling layers), NHWC layout (XLA:TPU's native conv layout),
+bfloat16-capable compute with float32 params, logits output feeding the
+same stable ELBO as the MLP VAE (``ops/losses.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class ConvVAE(nn.Module):
+    """Strided-conv encoder/decoder VAE for 32x32 RGB images.
+
+    Encoder: 32→16→8→4 spatial, channels (c, 2c, 4c) → dense latent.
+    Decoder mirrors with ConvTranspose, emitting flattened per-pixel
+    logits. Submodules live in ``setup`` so ``encode``/``decode`` are
+    directly callable via ``apply(..., method=...)`` — the same method
+    contract as :class:`models.vae.VAE`, which makes every train/eval/
+    sample step and the whole HPO scaffolding model-agnostic.
+    """
+
+    latent_dim: int = 64
+    base_channels: int = 32
+    image_hw: int = 32
+    image_channels: int = 3
+    dtype: Any = jnp.float32
+
+    @property
+    def input_dim(self) -> int:
+        return self.image_hw * self.image_hw * self.image_channels
+
+    def setup(self):
+        c = self.base_channels
+        conv = lambda ch, name: nn.Conv(
+            ch, (3, 3), strides=(2, 2), dtype=self.dtype,
+            param_dtype=jnp.float32, name=name,
+        )
+        deconv = lambda ch, name: nn.ConvTranspose(
+            ch, (3, 3), strides=(2, 2), dtype=self.dtype,
+            param_dtype=jnp.float32, name=name,
+        )
+        dense = lambda feats, name: nn.Dense(
+            feats, dtype=self.dtype, param_dtype=jnp.float32, name=name
+        )
+        self.enc0 = conv(c, "enc0")
+        self.enc1 = conv(2 * c, "enc1")
+        self.enc2 = conv(4 * c, "enc2")
+        self.mu_head = dense(self.latent_dim, "mu")
+        self.logvar_head = dense(self.latent_dim, "logvar")
+        hw8 = self.image_hw // 8
+        self.proj = dense(hw8 * hw8 * 4 * c, "proj")
+        self.dec0 = deconv(2 * c, "dec0")
+        self.dec1 = deconv(c, "dec1")
+        self.out = deconv(self.image_channels, "out")
+
+    def _to_image(self, x: jnp.ndarray) -> jnp.ndarray:
+        if x.ndim == 2:  # flattened Dataset rows
+            x = x.reshape(
+                (-1, self.image_hw, self.image_hw, self.image_channels)
+            )
+        return x.astype(self.dtype)
+
+    def encode(self, x: jnp.ndarray):
+        x = self._to_image(x)
+        for layer in (self.enc0, self.enc1, self.enc2):
+            x = nn.relu(layer(x))
+        x = x.reshape((x.shape[0], -1))
+        return self.mu_head(x), self.logvar_head(x)
+
+    def reparameterize(self, mu, logvar):
+        eps = jax.random.normal(
+            self.make_rng("reparam"), mu.shape, dtype=jnp.float32
+        ).astype(mu.dtype)
+        return mu + eps * jnp.exp(0.5 * logvar)
+
+    def decode(self, z: jnp.ndarray) -> jnp.ndarray:
+        """Decode to flattened per-pixel logits."""
+        c = self.base_channels
+        hw8 = self.image_hw // 8
+        x = nn.relu(self.proj(z.astype(self.dtype)))
+        x = x.reshape((-1, hw8, hw8, 4 * c))
+        x = nn.relu(self.dec0(x))
+        x = nn.relu(self.dec1(x))
+        x = self.out(x)
+        return x.reshape((x.shape[0], -1))
+
+    def decode_probs(self, z: jnp.ndarray) -> jnp.ndarray:
+        return nn.sigmoid(self.decode(z))
+
+    def __call__(self, x: jnp.ndarray):
+        mu, logvar = self.encode(x)
+        z = self.reparameterize(mu, logvar)
+        return self.decode(z), mu, logvar

@@ -1,0 +1,86 @@
+"""Classifier train/eval steps — same per-trial submesh contract as the
+VAE steps (BASELINE.md config 4: ResNet-18 HPO on the subgroup
+scaffolding).
+
+Identical execution model to ``train.steps``: params/opt state
+replicated over the trial submesh, (images, labels) batch sharded over
+the data axis, XLA-inserted gradient reduction. Reuses
+:class:`train.steps.TrainState` so checkpointing and PBT transfer work
+for classifiers unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from multidisttorch_tpu.ops.losses import softmax_cross_entropy_mean
+from multidisttorch_tpu.parallel.mesh import TrialMesh
+from multidisttorch_tpu.train.steps import TrainState
+
+
+def create_classifier_state(
+    trial: TrialMesh,
+    model: Any,
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+) -> TrainState:
+    params = model.init(
+        {"params": rng}, jnp.zeros((1, model.input_dim), jnp.float32)
+    )["params"]
+    state = TrainState(
+        params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32)
+    )
+    return trial.device_put(state)
+
+
+def make_classifier_train_step(
+    trial: TrialMesh, model: Any, tx: optax.GradientTransformation
+) -> Callable:
+    """``step(state, (images, labels)) -> (state, {loss, accuracy})``."""
+    repl = trial.replicated_sharding
+    data = trial.batch_sharding
+
+    def step_fn(state: TrainState, images: jax.Array, labels: jax.Array):
+        def loss_fn(params):
+            logits = model.apply({"params": params}, images)
+            return softmax_cross_entropy_mean(logits, labels), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        acc = jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+        new_state = TrainState(
+            params=new_params, opt_state=new_opt, step=state.step + 1
+        )
+        return new_state, {
+            "loss": loss.astype(jnp.float32),
+            "accuracy": acc,
+        }
+
+    return jax.jit(
+        step_fn,
+        in_shardings=(repl, data, data),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+
+
+def make_classifier_eval_step(trial: TrialMesh, model: Any) -> Callable:
+    repl = trial.replicated_sharding
+    data = trial.batch_sharding
+
+    def eval_fn(state: TrainState, images: jax.Array, labels: jax.Array):
+        logits = model.apply({"params": state.params}, images)
+        loss = softmax_cross_entropy_mean(logits, labels)
+        correct = jnp.sum(
+            (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        )
+        return {"loss": loss.astype(jnp.float32), "correct": correct}
+
+    return jax.jit(eval_fn, in_shardings=(repl, data, data), out_shardings=repl)

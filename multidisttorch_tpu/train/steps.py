@@ -121,14 +121,16 @@ def make_train_step(
 
 
 def make_eval_step(
-    trial: TrialMesh, model: VAE, *, beta: float = 1.0
+    trial: TrialMesh, model: VAE, *, beta: float = 1.0, with_recon: bool = True
 ) -> Callable[[TrainState, jax.Array], dict]:
-    """Compiled eval step: summed ELBO + reconstructions for one batch.
+    """Compiled eval step: summed ELBO (+ reconstructions) for one batch.
 
     The analog of the reference's ``test`` inner loop
-    (``vae-hpo.py:101-105``) minus the host-side PNG I/O; reconstruction
-    probabilities are returned so the caller can image them
-    (``vae-hpo.py:106-116``).
+    (``vae-hpo.py:101-105``) minus the host-side PNG I/O; with
+    ``with_recon=True`` reconstruction probabilities are returned so the
+    caller can image them (``vae-hpo.py:106-116``). Loss-only callers
+    (e.g. PBT scoring) pass ``with_recon=False`` to skip materializing
+    the (N, input_dim) output.
     """
     repl = trial.replicated_sharding
     data = trial.batch_sharding
@@ -137,18 +139,18 @@ def make_eval_step(
         n = batch.shape[0]
         flat = batch.reshape(n, -1)
         mu, logvar = model.apply(
-            {"params": state.params}, batch, method=VAE.encode
+            {"params": state.params}, batch, method="encode"
         )
         # Eval uses the posterior mean (no sampling): deterministic, and
         # a strictly tighter bound than the reference's sampled eval.
         recon_logits = model.apply(
-            {"params": state.params}, mu, method=VAE.decode
+            {"params": state.params}, mu, method="decode"
         )
         loss = elbo_loss_sum(recon_logits, flat, mu, logvar, beta)
-        return {
-            "loss_sum": loss.astype(jnp.float32),
-            "recon": jax.nn.sigmoid(recon_logits.astype(jnp.float32)),
-        }
+        out = {"loss_sum": loss.astype(jnp.float32)}
+        if with_recon:
+            out["recon"] = jax.nn.sigmoid(recon_logits.astype(jnp.float32))
+        return out
 
     return jax.jit(eval_fn, in_shardings=(repl, data), out_shardings=repl)
 
@@ -166,7 +168,7 @@ def make_sample_step(
     def sample_fn(state: TrainState, rng: jax.Array):
         z = jax.random.normal(rng, (num_samples, model.latent_dim))
         probs = model.apply(
-            {"params": state.params}, z, method=VAE.decode_probs
+            {"params": state.params}, z, method="decode_probs"
         )
         return probs.astype(jnp.float32)
 
