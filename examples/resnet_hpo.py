@@ -54,6 +54,8 @@ def main():
 
     trials = []
     for g, lr in zip(groups, lrs):
+        if not g.is_local_member:  # multi-host: skip remote submeshes
+            continue
         tx = optax.adam(lr)
         state = create_classifier_state(g, model, tx, jax.random.key(g.group_id))
         trials.append(

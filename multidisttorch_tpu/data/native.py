@@ -1,0 +1,160 @@
+"""ctypes binding for the native fastloader (csrc/fastloader.cpp).
+
+Builds ``libfastloader.so`` on first use via the Makefile (g++), loads
+it with ctypes, and exposes :class:`NativeBatchGatherer` — a
+background-threaded batch gatherer whose output is bit-identical to the
+numpy path (the permutation is computed in numpy and handed over, the
+C++ side owns only the no-GIL gather + prefetch overlap). If the
+toolchain is unavailable the import degrades to ``available() == False``
+and callers fall back to numpy gathering.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import warnings
+from typing import Optional
+
+import numpy as np
+
+_CSRC_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "csrc",
+)
+_LIB_PATH = os.path.join(_CSRC_DIR, "libfastloader.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _load_library() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_LIB_PATH):
+            try:
+                subprocess.run(
+                    ["make", "-C", _CSRC_DIR],
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except Exception as e:
+                warnings.warn(f"native fastloader build failed: {e!r}")
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            warnings.warn(f"native fastloader load failed: {e!r}")
+            _build_failed = True
+            return None
+        lib.fl_create.restype = ctypes.c_void_p
+        lib.fl_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        lib.fl_start_epoch.restype = ctypes.c_int64
+        lib.fl_start_epoch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.fl_next_batch.restype = ctypes.c_int64
+        lib.fl_next_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.fl_destroy.restype = None
+        lib.fl_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load_library() is not None
+
+
+class NativeBatchGatherer:
+    """Background-threaded batch gather over a host-resident dataset.
+
+    Usage::
+
+        g = NativeBatchGatherer(images, labels)
+        n_batches = g.start_epoch(perm, batch_size)
+        for _ in range(n_batches):
+            imgs, labels = g.next_batch()
+    """
+
+    def __init__(self, images: np.ndarray, labels: Optional[np.ndarray] = None):
+        lib = _load_library()
+        if lib is None:
+            raise RuntimeError("native fastloader unavailable")
+        self._lib = lib
+        # own contiguous float32/int32 copies for the library to borrow
+        self._images = np.ascontiguousarray(images, dtype=np.float32)
+        self._labels = (
+            np.ascontiguousarray(labels, dtype=np.int32)
+            if labels is not None
+            else None
+        )
+        self._dim = self._images.shape[1]
+        self._batch_size = 0
+        self._handle = lib.fl_create(
+            self._images.ctypes.data_as(ctypes.c_void_p),
+            self._images.shape[0],
+            self._dim,
+            self._labels.ctypes.data_as(ctypes.c_void_p)
+            if self._labels is not None
+            else None,
+        )
+        if not self._handle:
+            raise RuntimeError("fl_create failed")
+
+    def start_epoch(self, perm: np.ndarray, batch_size: int) -> int:
+        """Begin prefetching an epoch over ``perm``; returns #batches."""
+        self._perm = np.ascontiguousarray(perm, dtype=np.int64)  # keep alive
+        self._batch_size = int(batch_size)
+        n = self._lib.fl_start_epoch(
+            self._handle,
+            self._perm.ctypes.data_as(ctypes.c_void_p),
+            self._perm.shape[0],
+            self._batch_size,
+        )
+        if n < 0:
+            raise ValueError("fl_start_epoch rejected arguments")
+        return int(n)
+
+    def next_batch(self) -> tuple[np.ndarray, Optional[np.ndarray]]:
+        out = np.empty((self._batch_size, self._dim), np.float32)
+        out_labels = (
+            np.empty((self._batch_size,), np.int32)
+            if self._labels is not None
+            else None
+        )
+        rows = self._lib.fl_next_batch(
+            self._handle,
+            out.ctypes.data_as(ctypes.c_void_p),
+            out_labels.ctypes.data_as(ctypes.c_void_p)
+            if out_labels is not None
+            else None,
+        )
+        if rows < 0:
+            raise RuntimeError("fl_next_batch failed (invalid handle/buffer)")
+        if rows == 0:
+            raise StopIteration
+        return out, out_labels
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.fl_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

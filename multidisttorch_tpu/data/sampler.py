@@ -49,6 +49,7 @@ class TrialDataIterator:
         num_trials: Optional[int] = None,
         drop_remainder: bool = True,
         with_labels: bool = False,
+        use_native: Optional[bool] = None,
     ):
         if batch_size % trial.size != 0:
             raise ValueError(
@@ -74,12 +75,52 @@ class TrialDataIterator:
                 f"one batch of {batch_size}"
             )
 
+        # Native C++ prefetching gather (csrc/fastloader.cpp): identical
+        # output to the numpy path (same permutation), but the gather
+        # runs on a background thread without the GIL, overlapping the
+        # next batch with device compute. use_native=None → auto-enable
+        # when the library builds/loads; True → required; False → off.
+        # Each epoch() generator owns a PRIVATE gatherer: the library's
+        # epoch state is single-stream, and sharing one across
+        # concurrently-alive generators would silently mix epochs.
+        self._use_native = False
+        if use_native is not False:
+            from multidisttorch_tpu.data import native
+
+            if native.available():
+                self._use_native = True
+            elif use_native:
+                raise RuntimeError("native fastloader unavailable")
+
     def epoch(self, epoch: int) -> Iterator:
         """Iterate one epoch with a fresh (seed, epoch) permutation."""
         rng = np.random.default_rng(
             np.random.SeedSequence([self.seed, epoch])
         )
         perm = rng.permutation(self._indices)
+
+        if self._use_native:
+            from multidisttorch_tpu.data.native import NativeBatchGatherer
+
+            gatherer = NativeBatchGatherer(
+                self.dataset.images,
+                self.dataset.labels if self.with_labels else None,
+            )
+            try:
+                n = gatherer.start_epoch(perm, self.batch_size)
+                for _ in range(n):
+                    imgs_np, labels_np = gatherer.next_batch()
+                    imgs = jax.device_put(imgs_np, self.trial.batch_sharding)
+                    if self.with_labels:
+                        yield imgs, jax.device_put(
+                            labels_np, self.trial.batch_sharding
+                        )
+                    else:
+                        yield imgs
+            finally:
+                gatherer.close()
+            return
+
         for b in range(self.num_batches):
             idx = perm[b * self.batch_size : (b + 1) * self.batch_size]
             imgs = jax.device_put(

@@ -201,7 +201,11 @@ def run_pbt(
         }
 
         # --- exploit/explore: bottom n_exploit copy a top-n_exploit peer
-        top, bottom = ranked[:n_exploit], ranked[-n_exploit:]
+        # (guard: ranked[-0:] would be the WHOLE list, so population=1 —
+        # where n_exploit clamps to 0 — must skip the exchange entirely)
+        top, bottom = (
+            (ranked[:n_exploit], ranked[-n_exploit:]) if n_exploit else ([], [])
+        )
         for i, bad in enumerate(bottom):
             good = top[i % len(top)]
             if scores[bad.member_id] <= scores[good.member_id]:

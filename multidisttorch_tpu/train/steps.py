@@ -80,6 +80,7 @@ def make_train_step(
     tx: optax.GradientTransformation,
     *,
     beta: float = 1.0,
+    use_fused_loss: bool = False,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
     """Build the compiled train step for one trial submesh.
 
@@ -87,9 +88,18 @@ def make_train_step(
     ``batch`` is the trial-global batch (sharded over the submesh data
     axis on entry), and ``metrics['loss_sum']`` is the summed negative
     ELBO over the batch (reference logging contract, ``vae-hpo.py:73``).
+    ``use_fused_loss`` swaps in the single-pass Pallas ELBO kernel
+    (``ops/pallas_elbo.py``, forward + custom-VJP backward); default off
+    because XLA's own fusion is already competitive and composes with
+    the surrounding matmuls.
     """
     repl = trial.replicated_sharding
     data = trial.batch_sharding
+    loss_impl = elbo_loss_sum
+    if use_fused_loss:
+        from multidisttorch_tpu.ops.pallas_elbo import fused_elbo_loss_sum
+
+        loss_impl = fused_elbo_loss_sum
 
     def step_fn(state: TrainState, batch: jax.Array, rng: jax.Array):
         n = batch.shape[0]
@@ -98,7 +108,7 @@ def make_train_step(
             recon_logits, mu, logvar = model.apply(
                 {"params": params}, batch, rngs={"reparam": rng}
             )
-            total = elbo_loss_sum(
+            total = loss_impl(
                 recon_logits, batch.reshape(n, -1), mu, logvar, beta
             )
             return total / n

@@ -1,0 +1,72 @@
+"""Profiling / timing helpers.
+
+The reference's only instrumentation is one wall-clock print per trial
+(``/root/reference/vae-hpo.py:159,172-174``). Parity requires exactly
+that (:func:`trial_timer`); :func:`profile_trace` adds the nearly-free
+JAX profiler (TensorBoard-loadable traces incl. TPU device timelines),
+and :class:`StepTimer` gives per-step latency stats for finding host-
+side dispatch bottlenecks in multi-trial runs (SURVEY.md §7 "hard
+parts": contention is host-side).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@contextlib.contextmanager
+def trial_timer(label: str = "", printer=print):
+    """Wall-clock a block, printing ``"<label> Done. time: <s>"`` —
+    the reference's per-trial timing contract (``vae-hpo.py:174``)."""
+    t0 = time.time()
+    yield
+    t1 = time.time()
+    printer(f"{label}{' ' if label else ''}Done. time: {t1 - t0:f}")
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Capture a JAX profiler trace (view with TensorBoard's profile
+    plugin or Perfetto). Device timelines come for free on TPU."""
+    import jax
+
+    with jax.profiler.trace(log_dir, create_perfetto_link=False):
+        yield
+
+
+@dataclass
+class StepTimer:
+    """Rolling per-step latency collector.
+
+    Note: in an async-dispatch loop, per-step host time measures
+    *dispatch* cost; call ``mark(sync=True)`` (blocks on ``value``) at
+    sparse intervals to sample true device-inclusive step time.
+    """
+
+    times: list = field(default_factory=list)
+    _last: float = field(default_factory=time.perf_counter)
+
+    def mark(self, value=None, sync: bool = False):
+        if sync and value is not None:
+            import jax
+
+            jax.block_until_ready(value)
+        now = time.perf_counter()
+        self.times.append(now - self._last)
+        self._last = now
+
+    def stats(self) -> dict:
+        if not self.times:
+            return {}
+        arr = np.asarray(self.times)
+        return {
+            "steps": len(arr),
+            "mean_s": float(arr.mean()),
+            "p50_s": float(np.percentile(arr, 50)),
+            "p95_s": float(np.percentile(arr, 95)),
+            "total_s": float(arr.sum()),
+        }

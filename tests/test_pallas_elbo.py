@@ -1,0 +1,102 @@
+"""Fused Pallas ELBO kernel: value + gradient parity with the jnp path
+(interpreter mode on CPU; same code compiles for real TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multidisttorch_tpu.ops.losses import elbo_loss_sum
+from multidisttorch_tpu.ops.pallas_elbo import fused_elbo_loss_sum
+
+
+@pytest.fixture(scope="module")
+def arrays():
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(0, 2, (16, 784)).astype(np.float32))
+    x = jnp.asarray(rng.uniform(0, 1, (16, 784)).astype(np.float32))
+    mu = jnp.asarray(rng.normal(0, 1, (16, 20)).astype(np.float32))
+    logvar = jnp.asarray(rng.normal(0, 0.5, (16, 20)).astype(np.float32))
+    return logits, x, mu, logvar
+
+
+def test_value_parity(arrays):
+    logits, x, mu, logvar = arrays
+    fused = float(fused_elbo_loss_sum(logits, x, mu, logvar, 1.0))
+    plain = float(elbo_loss_sum(logits, x, mu, logvar, 1.0))
+    assert fused == pytest.approx(plain, rel=1e-5)
+
+
+def test_value_parity_beta(arrays):
+    logits, x, mu, logvar = arrays
+    fused = float(fused_elbo_loss_sum(logits, x, mu, logvar, 4.0))
+    plain = float(elbo_loss_sum(logits, x, mu, logvar, 4.0))
+    assert fused == pytest.approx(plain, rel=1e-5)
+
+
+def test_gradient_parity(arrays):
+    logits, x, mu, logvar = arrays
+
+    g_fused = jax.grad(
+        lambda l, m, lv: fused_elbo_loss_sum(l, x, m, lv, 2.0), argnums=(0, 1, 2)
+    )(logits, mu, logvar)
+    g_plain = jax.grad(
+        lambda l, m, lv: elbo_loss_sum(l, x, m, lv, 2.0), argnums=(0, 1, 2)
+    )(logits, mu, logvar)
+    for a, b in zip(g_fused, g_plain):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_works_under_jit_and_scaling(arrays):
+    logits, x, mu, logvar = arrays
+
+    @jax.jit
+    def f(l):
+        return fused_elbo_loss_sum(l, x, mu, logvar, 1.0) * 2.0
+
+    expected = 2.0 * float(elbo_loss_sum(logits, x, mu, logvar, 1.0))
+    assert float(f(logits)) == pytest.approx(expected, rel=1e-5)
+    # cotangent scaling flows through the custom VJP
+    g = jax.grad(f)(logits)
+    g_ref = jax.grad(lambda l: 2.0 * elbo_loss_sum(l, x, mu, logvar, 1.0))(logits)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(g_ref), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_fused_loss_in_train_step_matches_plain():
+    # The use_fused_loss train-step path must train identically.
+    import optax
+
+    from multidisttorch_tpu.models.vae import VAE
+    from multidisttorch_tpu.parallel.mesh import setup_groups
+    from multidisttorch_tpu.train.steps import (
+        create_train_state,
+        make_train_step,
+    )
+
+    model = VAE(hidden_dim=16, latent_dim=4)
+    tx = optax.adam(1e-3)
+    trial = setup_groups(8)[0]
+    batch = jnp.asarray(
+        np.random.default_rng(5).uniform(0, 1, (8, 784)).astype(np.float32)
+    )
+    key = jax.random.key(0)
+    s1 = create_train_state(trial, model, tx, jax.random.key(1))
+    s2 = create_train_state(trial, model, tx, jax.random.key(1))
+    s1, m1 = make_train_step(trial, model, tx)(s1, batch, key)
+    s2, m2 = make_train_step(trial, model, tx, use_fused_loss=True)(
+        s2, batch, key
+    )
+    assert float(m1["loss_sum"]) == pytest.approx(
+        float(m2["loss_sum"]), rel=1e-5
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        ),
+        s1.params,
+        s2.params,
+    )

@@ -1,0 +1,98 @@
+"""Imaging, profiling, checkpoint utility tests."""
+
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from multidisttorch_tpu.models.vae import VAE
+from multidisttorch_tpu.parallel.mesh import setup_groups
+from multidisttorch_tpu.train.checkpoint import restore_state, save_state
+from multidisttorch_tpu.train.steps import create_train_state, make_train_step
+from multidisttorch_tpu.utils.imaging import save_image_grid
+from multidisttorch_tpu.utils.profiling import StepTimer, trial_timer
+
+
+class TestImaging:
+    def test_grayscale_grid(self, tmp_path):
+        imgs = np.random.default_rng(0).uniform(0, 1, (16, 784))
+        path = save_image_grid(imgs, str(tmp_path / "grid.png"), nrow=8)
+        assert path.endswith(".png") or path.endswith(".npy")
+        assert os.path.exists(path)
+        if path.endswith(".png"):
+            from PIL import Image
+
+            im = Image.open(path)
+            assert im.size == (8 * 28, 2 * 28)
+
+    def test_rgb_grid(self, tmp_path):
+        imgs = np.random.default_rng(0).uniform(0, 1, (4, 32 * 32 * 3))
+        path = save_image_grid(imgs, str(tmp_path / "rgb.png"), nrow=4)
+        if path.endswith(".png"):
+            from PIL import Image
+
+            im = Image.open(path)
+            assert im.mode == "RGB"
+            assert im.size == (4 * 32, 32)
+
+    def test_3d_input(self, tmp_path):
+        imgs = np.zeros((3, 28, 28))
+        path = save_image_grid(imgs, str(tmp_path / "g3.png"), nrow=2)
+        assert os.path.exists(path)
+
+
+class TestCheckpoint:
+    def test_roundtrip_across_submeshes(self, tmp_path):
+        # Save a trained state from one submesh, restore onto another —
+        # the checkpoint-restart and PBT-transfer mechanism.
+        model = VAE(hidden_dim=16, latent_dim=4)
+        tx = optax.adam(1e-3)
+        g0, g1 = setup_groups(2)
+        state = create_train_state(g0, model, tx, jax.random.key(0))
+        step = make_train_step(g0, model, tx)
+        batch = jax.numpy.asarray(
+            np.random.default_rng(0).uniform(0, 1, (8, 784)).astype(np.float32)
+        )
+        state, _ = step(state, batch, jax.random.key(1))
+
+        path = save_state(state, str(tmp_path / "ck" / "state.msgpack"),
+                          metadata={"trial": 0})
+        assert os.path.exists(path)
+        assert os.path.exists(path + ".json")
+
+        template = create_train_state(g1, model, tx, jax.random.key(9))
+        restored = restore_state(template, path, trial=g1)
+        assert int(restored.step) == 1
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            jax.device_get(restored.params),
+            jax.device_get(state.params),
+        )
+        # restored state is live on the new submesh: take a step with it
+        step1 = make_train_step(g1, model, tx)
+        restored, m = step1(restored, batch, jax.random.key(2))
+        assert np.isfinite(float(m["loss_sum"]))
+
+
+class TestProfiling:
+    def test_trial_timer_prints_reference_format(self, capsys):
+        with trial_timer("trial 3", printer=print):
+            pass
+        out = capsys.readouterr().out
+        assert "trial 3 Done. time:" in out
+
+    def test_step_timer_stats(self):
+        t = StepTimer()
+        for _ in range(5):
+            t.mark()
+        s = t.stats()
+        assert s["steps"] == 5
+        assert s["total_s"] >= 0
+        assert s["p95_s"] >= s["p50_s"] or s["steps"] < 3
+
+    def test_empty_stats(self):
+        assert StepTimer().stats() == {}
