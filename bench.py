@@ -113,7 +113,90 @@ def bench_reference_torch() -> float:
     return TORCH_MEASURE_STEPS * BATCH / dt
 
 
+def bench_concurrency(num_trials: int) -> dict:
+    """North-star metric (BASELINE.md): per-chip throughput of N
+    concurrent trials, each on its own disjoint submesh, relative to one
+    trial running alone on an identical submesh. Target: >= 0.90 at 8
+    trials."""
+    from multidisttorch_tpu.models.vae import VAE
+    from multidisttorch_tpu.parallel.mesh import setup_groups
+    from multidisttorch_tpu.train.steps import create_train_state, make_train_step
+
+    groups = setup_groups(num_trials)
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    model = VAE(hidden_dim=HIDDEN, latent_dim=LATENT, dtype=dtype)
+    tx = optax.adam(1e-3)
+    batch_np = (
+        np.random.default_rng(0).uniform(0, 1, (BATCH, 784)).astype(np.float32)
+    )
+    key = jax.random.key(1)
+
+    def setup_trial(g):
+        state = create_train_state(g, model, tx, jax.random.key(g.group_id))
+        step = make_train_step(g, model, tx)
+        batch = jax.device_put(jnp.asarray(batch_np), g.batch_sharding)
+        return {"state": state, "step": step, "batch": batch}
+
+    trials = [setup_trial(g) for g in groups]
+
+    def run_steps(active, nsteps):
+        for i in range(nsteps):
+            for t in active:
+                t["state"], _ = t["step"](
+                    t["state"], t["batch"], jax.random.fold_in(key, i)
+                )
+        for t in active:
+            jax.block_until_ready(t["state"].params)
+
+    # warmup all compilations
+    run_steps(trials, WARMUP_STEPS)
+
+    # trial 0 alone on its submesh
+    t0 = time.perf_counter()
+    run_steps(trials[:1], MEASURE_STEPS)
+    alone_sps = MEASURE_STEPS * BATCH / (time.perf_counter() - t0)
+
+    # all trials concurrently
+    t0 = time.perf_counter()
+    run_steps(trials, MEASURE_STEPS)
+    dt = time.perf_counter() - t0
+    per_trial_sps = MEASURE_STEPS * BATCH / dt  # each trial did MEASURE_STEPS
+
+    return {
+        "num_trials": num_trials,
+        "alone_samples_per_sec": round(alone_sps, 1),
+        "concurrent_per_trial_samples_per_sec": round(per_trial_sps, 1),
+        "aggregate_samples_per_sec": round(per_trial_sps * num_trials, 1),
+        "efficiency_vs_alone": round(per_trial_sps / alone_sps, 3),
+    }
+
+
 def main():
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--concurrency", type=int, default=None,
+        help="measure N concurrent trials' per-chip efficiency instead of "
+        "the default single-chip throughput metric",
+    )
+    args = parser.parse_args()
+
+    if args.concurrency:
+        r = bench_concurrency(args.concurrency)
+        print(
+            json.dumps(
+                {
+                    "metric": "concurrent_trial_efficiency",
+                    "value": r["efficiency_vs_alone"],
+                    "unit": "frac_of_single_trial_throughput",
+                    "vs_baseline": round(r["efficiency_vs_alone"] / 0.90, 3),
+                    "detail": r,
+                }
+            )
+        )
+        return
+
     ours = bench_ours()
     try:
         ref = bench_reference_torch()

@@ -56,7 +56,13 @@ struct Loader {
 
   void join_worker() {
     if (worker.joinable()) {
-      stop.store(true);
+      {
+        // stop must be set under mu: otherwise the producer can read
+        // stop=false in its wait predicate, lose this notify, and block
+        // forever (deadlocking the join below).
+        std::lock_guard<std::mutex> lk(mu);
+        stop.store(true);
+      }
       cv_produce.notify_all();
       worker.join();
       stop.store(false);

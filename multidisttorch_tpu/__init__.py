@@ -32,6 +32,7 @@ from multidisttorch_tpu.parallel.cluster import (
     initialize_runtime,
     parse_slurm_nodelist,
     process_world,
+    sync_hosts,
 )
 from multidisttorch_tpu.parallel.mesh import (
     TrialMesh,
@@ -64,4 +65,5 @@ __all__ = [
     "parse_slurm_nodelist",
     "process_world",
     "setup_groups",
+    "sync_hosts",
 ]

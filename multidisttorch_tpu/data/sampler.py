@@ -47,7 +47,6 @@ class TrialDataIterator:
         seed: int = 0,
         shard_across_trials: bool = False,
         num_trials: Optional[int] = None,
-        drop_remainder: bool = True,
         with_labels: bool = False,
         use_native: Optional[bool] = None,
     ):
@@ -92,6 +91,23 @@ class TrialDataIterator:
             elif use_native:
                 raise RuntimeError("native fastloader unavailable")
 
+    def _put(self, rows: np.ndarray):
+        """Place a trial-global batch onto the submesh.
+
+        Single-controller: one ``device_put`` with the batch sharding.
+        Multi-controller: every process holds the identical trial-global
+        batch host-side (permutations are seed-deterministic, so no
+        broadcast is needed — the multi-host generalization of
+        ``vae-hpo.py:146``'s per-rank index math) and
+        ``make_array_from_callback`` slices out only the rows of this
+        process's addressable shards.
+        """
+        if jax.process_count() == 1:
+            return jax.device_put(rows, self.trial.batch_sharding)
+        return jax.make_array_from_callback(
+            rows.shape, self.trial.batch_sharding, lambda idx: rows[idx]
+        )
+
     def epoch(self, epoch: int) -> Iterator:
         """Iterate one epoch with a fresh (seed, epoch) permutation."""
         rng = np.random.default_rng(
@@ -110,11 +126,9 @@ class TrialDataIterator:
                 n = gatherer.start_epoch(perm, self.batch_size)
                 for _ in range(n):
                     imgs_np, labels_np = gatherer.next_batch()
-                    imgs = jax.device_put(imgs_np, self.trial.batch_sharding)
+                    imgs = self._put(imgs_np)
                     if self.with_labels:
-                        yield imgs, jax.device_put(
-                            labels_np, self.trial.batch_sharding
-                        )
+                        yield imgs, self._put(labels_np)
                     else:
                         yield imgs
             finally:
@@ -123,14 +137,9 @@ class TrialDataIterator:
 
         for b in range(self.num_batches):
             idx = perm[b * self.batch_size : (b + 1) * self.batch_size]
-            imgs = jax.device_put(
-                self.dataset.images[idx], self.trial.batch_sharding
-            )
+            imgs = self._put(self.dataset.images[idx])
             if self.with_labels:
-                labels = jax.device_put(
-                    self.dataset.labels[idx], self.trial.batch_sharding
-                )
-                yield imgs, labels
+                yield imgs, self._put(self.dataset.labels[idx])
             else:
                 yield imgs
 

@@ -4,3 +4,8 @@ from multidisttorch_tpu.ops.losses import (
     gaussian_kl_sum,
     softmax_cross_entropy_mean,
 )
+from multidisttorch_tpu.ops.pallas_elbo import fused_elbo_loss_sum
+from multidisttorch_tpu.ops.ring_attention import (
+    dense_attention_reference,
+    make_ring_attention,
+)

@@ -1,0 +1,149 @@
+"""Ring attention: sequence-parallel exact attention over a device axis.
+
+The reference framework has no attention or sequence dimension at all
+(SURVEY.md §5 — its model is an MLP VAE), but a framework claiming its
+scale on TPU must handle long-context models whose sequences exceed one
+chip's HBM. This op shards the sequence across a (sub)mesh axis and
+computes **exact** softmax attention by rotating K/V blocks around the
+ring with ``jax.lax.ppermute`` (ICI neighbor exchanges — the topology
+ring attention was designed for), carrying the online-softmax running
+max/sum so no device ever materializes the full (T, T) score matrix.
+
+Memory per device: O(T/n · T/n) scores instead of O(T²); communication:
+n-1 neighbor hops of the local K/V block, overlapped by XLA with the
+per-block compute. Composes with the framework's trial parallelism: the
+ring axis is any ``TrialMesh``'s data axis, so one trial can run
+sequence-parallel attention while others train unrelated models.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from multidisttorch_tpu.parallel.mesh import DATA_AXIS, TrialMesh
+
+
+def _attention_block(q, k, v, q_pos, k_pos, m, l, acc, *, causal, scale):
+    """One online-softmax update of local Q against one K/V block.
+
+    q: (B, Tq, H, D); k, v: (B, Tk, H, D); m, l: (B, H, Tq);
+    acc: (B, Tq, H, D). Standard flash-attention running update.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # (B, H, Tq, Tk)
+    if causal:
+        mask = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
+        s = jnp.where(mask, s, -jnp.inf)
+    blk_max = jnp.max(s, axis=-1)  # (B, H, Tq)
+    m_new = jnp.maximum(m, blk_max)
+    # guard fully-masked rows (m_new == -inf): keep them at zero weight
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - safe_m[..., None])  # (B, H, Tq, Tk)
+    if causal:
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+    correction = jnp.where(
+        jnp.isfinite(m), jnp.exp(m - safe_m), jnp.zeros_like(m)
+    )
+    l_new = l * correction + jnp.sum(p, axis=-1)
+    acc_new = acc * correction.transpose(0, 2, 1)[..., None] + jnp.einsum(
+        "bhqk,bkhd->bqhd", p, v
+    )
+    return m_new, l_new, acc_new
+
+
+def _ring_attention_local(q, k, v, *, axis_name, num_devices, causal, scale):
+    """Per-device body under shard_map: local Q stays put, K/V rotate."""
+    my_idx = jax.lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    q_pos = my_idx * t_local + jnp.arange(t_local)
+
+    b, _, h, d = q.shape
+    # The carry starts as constants but becomes device-varying through
+    # the loop body; shard_map's VMA typing requires the initial carry
+    # to carry the axis annotation already (pcast to 'varying'; older
+    # JAX spells it pvary).
+    if hasattr(jax.lax, "pcast"):
+        _vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
+    else:  # pragma: no cover
+        _vary = lambda x: jax.lax.pvary(x, axis_name)
+    m0 = _vary(jnp.full((b, h, t_local), -jnp.inf, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, t_local), jnp.float32))
+    acc0 = _vary(jnp.zeros((b, t_local, h, d), jnp.float32))
+
+    def body(step, carry):
+        k_blk, v_blk, m, l, acc = carry
+        src_idx = (my_idx - step) % num_devices
+        k_pos = src_idx * t_local + jnp.arange(t_local)
+        m, l, acc = _attention_block(
+            q.astype(jnp.float32),
+            k_blk.astype(jnp.float32),
+            v_blk.astype(jnp.float32),
+            q_pos,
+            k_pos,
+            m,
+            l,
+            acc,
+            causal=causal,
+            scale=scale,
+        )
+        # rotate K/V one hop around the ring (device i -> i+1), so next
+        # step this device holds the block of (my_idx - step - 1) % n
+        perm = [(i, (i + 1) % num_devices) for i in range(num_devices)]
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m, l, acc
+
+    _, _, m, l, acc = jax.lax.fori_loop(0, num_devices, body, (k, v, m0, l0, acc0))
+    # normalize; fully-masked rows (l == 0) return zeros
+    denom = jnp.where(l > 0, l, 1.0).transpose(0, 2, 1)[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+@lru_cache(maxsize=None)
+def _make_ring_attention_cached(mesh: Mesh, axis_name: str, causal: bool):
+    num_devices = int(mesh.shape[axis_name])
+    spec = P(None, axis_name, None, None)  # shard the sequence dim
+
+    def fn(q, k, v):
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        return jax.shard_map(
+            partial(
+                _ring_attention_local,
+                axis_name=axis_name,
+                num_devices=num_devices,
+                causal=causal,
+                scale=scale,
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+        )(q, k, v)
+
+    return jax.jit(fn)
+
+
+def make_ring_attention(trial: TrialMesh | Mesh, *, causal: bool = False):
+    """Compiled sequence-parallel attention over a trial's device axis.
+
+    Returns ``fn(q, k, v) -> out`` for arrays of shape ``(batch, seq,
+    heads, head_dim)`` with ``seq`` divisible by the submesh size; the
+    sequence dimension is sharded across the axis, and the result is
+    numerically exact attention (fp32 accumulation).
+    """
+    mesh = trial.mesh if isinstance(trial, TrialMesh) else trial
+    return _make_ring_attention_cached(mesh, DATA_AXIS, causal)
+
+
+def dense_attention_reference(q, k, v, *, causal: bool = False):
+    """O(T²) single-device reference for testing."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(tk)[None, :] <= jnp.arange(tq)[:, None]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)

@@ -6,6 +6,7 @@ from multidisttorch_tpu.parallel.cluster import (
     initialize_runtime,
     parse_slurm_nodelist,
     process_world,
+    sync_hosts,
 )
 from multidisttorch_tpu.parallel.collectives import (
     group_all_gather,

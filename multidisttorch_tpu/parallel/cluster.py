@@ -234,6 +234,24 @@ def initialize_runtime(
     return penv.num_processes, penv.process_id
 
 
+def sync_hosts(name: str = "sync") -> None:
+    """Barrier across host processes (multi-controller only).
+
+    The analog of the reference's ``dist.barrier()`` — but deliberately
+    NOT used anywhere in the trial path (the reference's world-scoped
+    barriers serialize the sweep, quirk Q3). Provided for host-side
+    coordination such as "download data once before dispatch"
+    (``vae-hpo.py:133-144``) and end-of-job collection. No-op
+    single-controller.
+    """
+    import jax
+
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
 def process_world() -> tuple[int, int]:
     """Process count and index, ``(size, rank)``.
 

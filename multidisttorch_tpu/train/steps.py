@@ -97,9 +97,32 @@ def make_train_step(
     data = trial.batch_sharding
     loss_impl = elbo_loss_sum
     if use_fused_loss:
-        from multidisttorch_tpu.ops.pallas_elbo import fused_elbo_loss_sum
+        from jax.sharding import PartitionSpec as _P
 
-        loss_impl = fused_elbo_loss_sum
+        from multidisttorch_tpu.ops.pallas_elbo import fused_elbo_loss_sum
+        from multidisttorch_tpu.parallel.mesh import DATA_AXIS as _AXIS
+
+        if trial.size == 1:
+            loss_impl = fused_elbo_loss_sum
+        else:
+            # A bare Pallas custom call is opaque to the partitioner, so
+            # on a multi-device submesh XLA would all-gather all four
+            # operands onto every chip. Run the kernel per-shard under
+            # shard_map and psum the partial sums instead — each chip
+            # reduces only its own batch rows.
+            def loss_impl(logits, x, mu, logvar, beta):
+                return jax.shard_map(
+                    lambda lo, xx, m, lv: jax.lax.psum(
+                        fused_elbo_loss_sum(lo, xx, m, lv, beta), _AXIS
+                    ),
+                    mesh=trial.mesh,
+                    in_specs=(_P(_AXIS), _P(_AXIS), _P(_AXIS), _P(_AXIS)),
+                    out_specs=_P(),
+                    # pallas_call's out_shape carries no VMA annotation,
+                    # so the varying-axis checker can't type it; the
+                    # trailing psum makes the result replicated anyway.
+                    check_vma=False,
+                )(logits, x, mu, logvar)
 
     def step_fn(state: TrainState, batch: jax.Array, rng: jax.Array):
         n = batch.shape[0]
