@@ -182,7 +182,9 @@ def main():
     )
     args = parser.parse_args()
 
-    if args.concurrency:
+    if args.concurrency is not None and args.concurrency < 1:
+        parser.error(f"--concurrency must be >= 1, got {args.concurrency}")
+    if args.concurrency is not None:
         r = bench_concurrency(args.concurrency)
         print(
             json.dumps(

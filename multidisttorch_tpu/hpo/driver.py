@@ -20,6 +20,19 @@ the one it belongs to, and runs a DDP trial whose only hyperparameter is
   submesh intersects its local devices (``TrialMesh.is_local_member``) —
   the same membership contract as the reference's
   ``dist.get_rank(group) >= 0`` (``vae-hpo.py:201``).
+- **Elastic scheduling**: more configs than submeshes is legal — the
+  reference hard-binds one trial per group forever (``vae-hpo.py:
+  200-202``); here freed submeshes immediately pick up the next queued
+  config (greedy single-controller; deterministic round-robin
+  assignment multi-controller, where every process must schedule
+  identically without communicating).
+- **Failure isolation** (``resilient=True``): one trial's exception
+  marks that trial failed and frees its submesh; the rest of the sweep
+  proceeds. The reference has no failure handling at all — a dead rank
+  hangs every world barrier (SURVEY.md §5).
+- **Checkpoint/resume** (``resume=True``): per-epoch checkpoints; a
+  re-run restores each trial at its last completed epoch (or skips it
+  entirely if done). The reference persists nothing but PNGs.
 """
 
 from __future__ import annotations
@@ -38,7 +51,7 @@ from multidisttorch_tpu.data.datasets import Dataset
 from multidisttorch_tpu.data.sampler import TrialDataIterator
 from multidisttorch_tpu.models.vae import VAE
 from multidisttorch_tpu.parallel.mesh import TrialMesh, setup_groups
-from multidisttorch_tpu.train.checkpoint import save_state
+from multidisttorch_tpu.train.checkpoint import restore_state, save_state
 from multidisttorch_tpu.train.steps import (
     create_train_state,
     make_eval_step,
@@ -77,6 +90,8 @@ class TrialResult:
     steps: int = 0
     out_dir: str = ""
     checkpoint: str = ""
+    status: str = "completed"  # "completed" | "failed" | "resumed_complete"
+    error: str = ""
 
 
 class _TrialRun:
@@ -103,6 +118,7 @@ class _TrialRun:
         save_checkpoint: bool = True,
         verbose: bool = True,
         model_builder=None,
+        resume: bool = False,
     ):
         self.trial = trial
         self.cfg = cfg
@@ -145,6 +161,56 @@ class _TrialRun:
         )
         self._key = jax.random.key(cfg.seed + 1)
 
+        # Resume: per-epoch checkpoints carry (state, completed_epochs,
+        # history); restore at the last epoch boundary. Epoch data order
+        # and step RNG are deterministic in (seed, epoch) / step number,
+        # so a resumed run replays the exact remaining stream.
+        self._ckpt_path = os.path.join(self.out_dir, "state.msgpack")
+        self._start_epoch = 1
+        if resume:
+            meta_path = self._ckpt_path + ".json"
+            if os.path.exists(self._ckpt_path) and os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    meta = json.load(f)
+                # Guard against resuming under silently-changed
+                # hyperparameters: everything except the epoch target
+                # (extending epochs is the legitimate resume use) must
+                # match the checkpoint's saved config.
+                saved = {
+                    k: meta[k]
+                    for k in asdict(cfg)
+                    if k != "epochs" and k in meta
+                }
+                current = {k: v for k, v in asdict(cfg).items() if k != "epochs"}
+                if saved and saved != current:
+                    diff = {
+                        k: (saved.get(k), current[k])
+                        for k in current
+                        if saved.get(k) != current[k]
+                    }
+                    raise ValueError(
+                        f"resume: trial {cfg.trial_id} checkpoint at "
+                        f"{self._ckpt_path} was written under different "
+                        f"hyperparameters {diff} (saved vs current); "
+                        "refusing to continue stale weights under a "
+                        "changed config"
+                    )
+                done = int(meta.get("completed_epochs", 0))
+                if done >= 1:
+                    self.state = restore_state(
+                        self.state, self._ckpt_path, trial
+                    )
+                    self._start_epoch = done + 1
+                    self.result.history = list(meta.get("history", []))
+                    if self.result.history:
+                        last = self.result.history[-1]
+                        self.result.final_train_loss = last.get(
+                            "avg_train_loss", float("nan")
+                        )
+                        self.result.final_test_loss = last.get(
+                            "test_loss", float("nan")
+                        )
+
     def _log(self, *args):
         if self._verbose:
             log0(*args, trial=self.trial)
@@ -152,9 +218,18 @@ class _TrialRun:
     def run(self) -> Iterator[None]:
         cfg = self.cfg
         t0 = time.time()
+        if self._start_epoch > cfg.epochs:
+            # Fully-trained checkpoint found: nothing to replay.
+            self.result.status = "resumed_complete"
+            self.result.steps = int(jax.device_get(self.state.step))
+            self.result.checkpoint = self._ckpt_path
+            self._log(f"Trial {cfg.trial_id} already complete; resumed.")
+            return
         n_per_epoch = self.train_iter.samples_per_epoch
-        step_no = 0
-        for epoch in range(1, cfg.epochs + 1):
+        # state.step counts optimizer updates, so it doubles as the
+        # resume-safe global step for RNG folding.
+        step_no = int(jax.device_get(self.state.step))
+        for epoch in range(self._start_epoch, cfg.epochs + 1):
             epoch_loss_sums = []
             for i, batch in enumerate(self.train_iter.epoch(epoch)):
                 rng = jax.random.fold_in(self._key, step_no)
@@ -227,17 +302,22 @@ class _TrialRun:
 
             self.result.history.append(epoch_record)
             self.result.final_train_loss = avg
+            if self._save_checkpoint:
+                # per-epoch checkpoint = the resume boundary
+                self.result.checkpoint = save_state(
+                    self.state,
+                    self._ckpt_path,
+                    metadata={
+                        **asdict(cfg),
+                        "completed_epochs": epoch,
+                        "history": self.result.history,
+                    },
+                )
 
         # drain the pipeline so wall-clock covers real completion
         jax.block_until_ready(self.state.params)
         self.result.wall_s = time.time() - t0
         self.result.steps = step_no
-        if self._save_checkpoint:
-            self.result.checkpoint = save_state(
-                self.state,
-                os.path.join(self.out_dir, "state.msgpack"),
-                metadata=asdict(cfg),
-            )
         os.makedirs(self.out_dir, exist_ok=True)
         with open(os.path.join(self.out_dir, "metrics.json"), "w") as f:
             json.dump(
@@ -261,61 +341,160 @@ def run_hpo(
     test_data: Optional[Dataset] = None,
     *,
     groups: Optional[Sequence[TrialMesh]] = None,
+    num_groups: Optional[int] = None,
     out_dir: str = "results",
     shard_across_trials: bool = False,
     save_images: bool = True,
     save_checkpoints: bool = True,
     verbose: bool = True,
     model_builder=None,
+    resilient: bool = False,
+    resume: bool = False,
 ) -> list[TrialResult]:
-    """Run one trial per config, each on its own disjoint submesh,
-    concurrently, with no cross-trial synchronization.
+    """Run the configs over disjoint submeshes, concurrently, with no
+    cross-trial synchronization.
 
-    ``groups`` defaults to ``setup_groups(len(configs))`` over all
-    devices. Trials whose submesh has no local devices are skipped on
-    this process (multi-controller membership, ``vae-hpo.py:200-202``).
+    ``groups`` defaults to ``setup_groups(num_groups or len(configs))``.
+    **More configs than groups is legal**: excess configs queue, and a
+    submesh picks up its next trial the moment its current one finishes
+    (greedy in single-controller mode; in multi-controller SPMD the
+    assignment is the deterministic round-robin ``config i → group
+    i % G``, because every process must make identical scheduling
+    decisions without communicating). Trials whose submesh has no local
+    devices are skipped on this process (multi-controller membership,
+    ``vae-hpo.py:200-202``).
+
     ``model_builder(cfg)`` swaps the model family (e.g. ``ConvVAE`` for
     the β-VAE CIFAR config) while reusing all scaffolding; default is
-    the flagship MLP VAE. Returns results for locally-run trials, in
-    config order.
+    the flagship MLP VAE.
+
+    ``resilient=True`` isolates failures: a trial raising marks its
+    result ``status="failed"`` (exception text in ``.error``), frees the
+    submesh, and the sweep continues. Default re-raises (honest errors,
+    SURVEY.md Q8).
+
+    ``resume=True`` restores each trial from its per-epoch checkpoint
+    under ``{out_dir}/trial-{id}/`` (skipping fully-trained trials), so
+    an interrupted sweep re-run completes only the remaining work.
+
+    Returns results for locally-run trials, in config order.
     """
     if groups is None:
-        groups = setup_groups(len(configs))
-    if len(groups) != len(configs):
+        groups = setup_groups(
+            num_groups if num_groups is not None else len(configs)
+        )
+    if len(configs) < len(groups):
         raise ValueError(
-            f"{len(configs)} configs but {len(groups)} device groups"
+            f"{len(configs)} configs but {len(groups)} device groups "
+            "(fewer configs than groups would idle submeshes; carve "
+            "fewer groups instead)"
+        )
+    if resilient and jax.process_count() > 1:
+        raise NotImplementedError(
+            "resilient=True requires single-controller mode: failure "
+            "handling is process-local, so on a multi-process submesh "
+            "one process would free the group while its peers keep "
+            "stepping the failed trial, desynchronizing collectives. "
+            "Multi-host failure isolation needs a cross-process "
+            "agreement protocol — planned."
         )
 
-    runs = [
-        _TrialRun(
+    def make_run(trial: TrialMesh, cfg: TrialConfig) -> _TrialRun:
+        return _TrialRun(
             trial,
             cfg,
             train_data,
             test_data,
             out_dir,
             shard_across_trials=shard_across_trials,
-            num_trials=len(configs),
+            # Shard by submesh, not by config: with elastic scheduling
+            # (more configs than groups) group_id::len(groups) is still a
+            # valid partition of the dataset, config-count-based sharding
+            # would leave rows unassigned.
+            num_trials=len(groups),
             save_images=save_images,
             save_checkpoint=save_checkpoints,
             verbose=verbose,
             model_builder=model_builder,
+            resume=resume,
         )
-        for trial, cfg in zip(groups, configs)
-        if trial.is_local_member
-    ]
+
+    # Queue configs per group. Single-controller: one shared queue,
+    # greedy — whichever submesh frees first takes the next config
+    # (optimal when trials have unequal epoch counts). Multi-controller:
+    # static round-robin so all processes agree on every assignment.
+    single = jax.process_count() == 1
+    shared: list[tuple[int, TrialConfig]] = list(enumerate(configs))
+    per_group: dict[int, list[tuple[int, TrialConfig]]] = {
+        g.group_id: [] for g in groups
+    }
+    if not single:
+        for i, cfg in enumerate(configs):
+            per_group[groups[i % len(groups)].group_id].append((i, cfg))
+    queue_of = (
+        (lambda g: shared) if single else (lambda g: per_group[g.group_id])
+    )
+
+    local_groups = [g for g in groups if g.is_local_member]
+    results: dict[int, TrialResult] = {}
+    # group -> (config_index, run, generator) of its in-flight trial
+    active: dict[int, tuple[int, _TrialRun, Iterator[None]]] = {}
+
+    def start_next(g: TrialMesh) -> bool:
+        q = queue_of(g)
+        while q:
+            i, cfg = q.pop(0)
+            try:
+                run = make_run(g, cfg)
+            except Exception as e:  # noqa: BLE001 — setup failure isolation
+                results[i] = TrialResult(
+                    trial_id=cfg.trial_id,
+                    group_id=g.group_id,
+                    config=cfg,
+                    status="failed",
+                    error=f"{type(e).__name__}: {e}",
+                )
+                if not resilient:
+                    raise
+                log0(
+                    f"Trial {cfg.trial_id} FAILED at setup "
+                    f"({results[i].error}); sweep continues",
+                    trial=g,
+                )
+                continue
+            active[g.group_id] = (i, run, run.run())
+            return True
+        return False
+
+    for g in local_groups:
+        start_next(g)
 
     # Cooperative round-robin: one async step dispatch per trial per
-    # cycle. Finished trials drop out; the loop ends when all are done —
-    # the sweep's wall-clock is bounded by its slowest trial's *own*
-    # work, never by barriers (Q3 fixed).
-    active = [(r, r.run()) for r in runs]
+    # cycle. A finished (or failed) trial frees its submesh, which
+    # immediately starts its next queued config — the sweep's wall-clock
+    # is bounded by real work, never by barriers (Q3 fixed).
     while active:
-        still = []
-        for r, gen in active:
+        for g in local_groups:
+            if g.group_id not in active:
+                continue
+            i, run, gen = active[g.group_id]
             try:
                 next(gen)
-                still.append((r, gen))
             except StopIteration:
-                pass
-        active = still
-    return [r.result for r in runs]
+                results[i] = run.result
+                del active[g.group_id]
+                start_next(g)
+            except Exception as e:  # noqa: BLE001 — failure isolation
+                run.result.status = "failed"
+                run.result.error = f"{type(e).__name__}: {e}"
+                results[i] = run.result
+                del active[g.group_id]
+                if not resilient:
+                    raise
+                log0(
+                    f"Trial {run.cfg.trial_id} FAILED ({run.result.error}); "
+                    "submesh freed, sweep continues",
+                    trial=g,
+                )
+                start_next(g)
+    return [results[i] for i in sorted(results)]

@@ -124,3 +124,134 @@ def test_logging_parity_format(tmp_path, data, capsys):
     assert "====> Epoch: 1 Average loss:" in out
     assert "====> Test set loss:" in out
     assert "[0:0]" in out  # provenance prefix
+
+
+def test_elastic_more_configs_than_groups(tmp_path, data):
+    # The reference hard-binds one trial per group forever
+    # (vae-hpo.py:200-202); here 5 configs share 2 submeshes, freed
+    # groups picking up queued work.
+    train, _ = data
+    configs = [_small_cfg(i, epochs=1 + (i % 2)) for i in range(5)]
+    results = run_hpo(
+        configs, train, None, num_groups=2, out_dir=str(tmp_path),
+        verbose=False, save_images=False, save_checkpoints=False,
+    )
+    assert [r.trial_id for r in results] == [0, 1, 2, 3, 4]
+    for r in results:
+        assert r.status == "completed"
+        assert r.steps == 8 * r.config.epochs
+    # both submeshes were used
+    assert len({r.group_id for r in results}) == 2
+
+
+def test_resilient_sweep_isolates_failures(tmp_path, data):
+    train, _ = data
+
+    def builder(cfg):
+        from multidisttorch_tpu.models.vae import VAE
+
+        if cfg.trial_id == 1:
+            raise RuntimeError("boom")
+        return VAE(hidden_dim=cfg.hidden_dim, latent_dim=cfg.latent_dim)
+
+    configs = [_small_cfg(i) for i in range(3)]
+    results = run_hpo(
+        configs, train, None, num_groups=2, out_dir=str(tmp_path),
+        verbose=False, save_images=False, save_checkpoints=False,
+        model_builder=builder, resilient=True,
+    )
+    statuses = {r.trial_id: r.status for r in results}
+    assert statuses == {0: "completed", 1: "failed", 2: "completed"}
+    failed = next(r for r in results if r.trial_id == 1)
+    assert "boom" in failed.error
+
+
+def test_non_resilient_sweep_raises(tmp_path, data):
+    train, _ = data
+
+    def builder(cfg):
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        run_hpo(
+            [_small_cfg(0)], train, None, out_dir=str(tmp_path),
+            verbose=False, save_images=False, save_checkpoints=False,
+            model_builder=builder,
+        )
+
+
+def test_resume_continues_from_checkpoint(tmp_path, data):
+    train, _ = data
+    # phase 1: train 1 epoch with checkpoints ("interrupted" sweep)
+    r1 = run_hpo(
+        [_small_cfg(0, epochs=1)], train, None, out_dir=str(tmp_path),
+        verbose=False, save_images=False,
+    )[0]
+    assert r1.steps == 8
+
+    # phase 2: same trial, target 3 epochs, resume -> trains only 2 more
+    r2 = run_hpo(
+        [_small_cfg(0, epochs=3)], train, None, out_dir=str(tmp_path),
+        verbose=False, save_images=False, resume=True,
+    )[0]
+    assert r2.status == "completed"
+    assert r2.steps == 24  # cumulative optimizer steps across both runs
+    assert len(r2.history) == 3  # epoch-1 record restored + 2 new
+
+    # phase 3: everything done -> skipped entirely
+    r3 = run_hpo(
+        [_small_cfg(0, epochs=3)], train, None, out_dir=str(tmp_path),
+        verbose=False, save_images=False, resume=True,
+    )[0]
+    assert r3.status == "resumed_complete"
+    assert r3.steps == 24
+
+
+def test_resume_matches_uninterrupted_run(tmp_path, data):
+    # Determinism: 1 epoch + resumed 2 == straight 2 epochs, bitwise on
+    # the final train loss (same data permutations, same step RNG).
+    train, _ = data
+    straight = run_hpo(
+        [_small_cfg(0, epochs=2)], train, None,
+        out_dir=str(tmp_path / "straight"), verbose=False,
+        save_images=False,
+    )[0]
+    run_hpo(
+        [_small_cfg(0, epochs=1)], train, None,
+        out_dir=str(tmp_path / "resumed"), verbose=False,
+        save_images=False,
+    )
+    resumed = run_hpo(
+        [_small_cfg(0, epochs=2)], train, None,
+        out_dir=str(tmp_path / "resumed"), verbose=False,
+        save_images=False, resume=True,
+    )[0]
+    assert resumed.final_train_loss == straight.final_train_loss
+
+
+def test_resume_refuses_changed_hyperparameters(tmp_path, data):
+    train, _ = data
+    run_hpo(
+        [_small_cfg(0, epochs=1, lr=1e-3)], train, None,
+        out_dir=str(tmp_path), verbose=False, save_images=False,
+    )
+    with pytest.raises(ValueError, match="different\\s+hyperparameters"):
+        run_hpo(
+            [_small_cfg(0, epochs=2, lr=1e-2)], train, None,
+            out_dir=str(tmp_path), verbose=False, save_images=False,
+            resume=True,
+        )
+
+
+def test_elastic_shard_across_trials_partitions_by_group(tmp_path, data):
+    # Legacy Q1 sharding under elastic scheduling: shards are keyed by
+    # submesh (a valid partition), not by config count.
+    train, _ = data
+    configs = [_small_cfg(i) for i in range(4)]
+    results = run_hpo(
+        configs, train, None, num_groups=2, out_dir=str(tmp_path),
+        shard_across_trials=True, verbose=False,
+        save_images=False, save_checkpoints=False,
+    )
+    # each group's shard is 64 of 128 rows -> 4 batches of 16 per trial
+    assert all(r.steps == 4 for r in results)
