@@ -50,10 +50,11 @@ class TrialDataIterator:
         with_labels: bool = False,
         use_native: Optional[bool] = None,
     ):
-        if batch_size % trial.size != 0:
+        if batch_size % trial.data_size != 0:
             raise ValueError(
                 f"batch_size {batch_size} must divide evenly over the "
-                f"trial's {trial.size} devices (static per-device shapes)"
+                f"trial's data axis of {trial.data_size} devices "
+                "(static per-device shapes)"
             )
         self.dataset = dataset
         self.trial = trial

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from dataclasses import dataclass, field, asdict
 from typing import Iterator, Optional, Sequence
@@ -166,6 +167,7 @@ class _TrialRun:
         # and step RNG are deterministic in (seed, epoch) / step number,
         # so a resumed run replays the exact remaining stream.
         self._ckpt_path = os.path.join(self.out_dir, "state.msgpack")
+        self._ckpt_thread: Optional[threading.Thread] = None
         self._start_epoch = 1
         if resume:
             meta_path = self._ckpt_path + ".json"
@@ -303,19 +305,36 @@ class _TrialRun:
             self.result.history.append(epoch_record)
             self.result.final_train_loss = avg
             if self._save_checkpoint:
-                # per-epoch checkpoint = the resume boundary
-                self.result.checkpoint = save_state(
-                    self.state,
-                    self._ckpt_path,
-                    metadata={
-                        **asdict(cfg),
-                        "completed_epochs": epoch,
-                        "history": self.result.history,
-                    },
+                # Per-epoch checkpoint = the resume boundary. Keep the
+                # scheduler loop responsive: start the device→host copy
+                # async, yield once so other trials keep dispatching,
+                # then hand the serialize+disk-write to a background
+                # thread. The snapshot is taken before the next epoch's
+                # first step, so donation can't invalidate it.
+                jax.tree.map(lambda x: x.copy_to_host_async(), self.state)
+                yield
+                host_state = jax.device_get(self.state)
+                meta = {
+                    **asdict(cfg),
+                    "completed_epochs": epoch,
+                    "history": list(self.result.history),
+                }
+                if self._ckpt_thread is not None:
+                    self._ckpt_thread.join()
+                self._ckpt_thread = threading.Thread(
+                    target=save_state,
+                    args=(host_state, self._ckpt_path),
+                    kwargs={"metadata": meta},
+                    daemon=True,
                 )
+                self._ckpt_thread.start()
+                self.result.checkpoint = self._ckpt_path
 
         # drain the pipeline so wall-clock covers real completion
         jax.block_until_ready(self.state.params)
+        if self._ckpt_thread is not None:
+            self._ckpt_thread.join()
+            self._ckpt_thread = None
         self.result.wall_s = time.time() - t0
         self.result.steps = step_no
         os.makedirs(self.out_dir, exist_ok=True)

@@ -101,11 +101,11 @@ class _Member:
         )
         # eval batch must keep the per-device divisibility invariant
         eval_bs = min(cfg.batch_size, len(eval_data))
-        eval_bs -= eval_bs % trial.size
+        eval_bs -= eval_bs % trial.data_size
         if eval_bs == 0:
             raise ValueError(
                 f"eval set of {len(eval_data)} rows too small for a "
-                f"{trial.size}-device submesh"
+                f"{trial.data_size}-wide data axis"
             )
         self.eval_iter = TrialDataIterator(eval_data, trial, eval_bs, seed=0)
         self._epoch = 0

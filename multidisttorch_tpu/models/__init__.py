@@ -1,3 +1,3 @@
 from multidisttorch_tpu.models.conv_vae import ConvVAE
 from multidisttorch_tpu.models.resnet import ResNet, ResNet18
-from multidisttorch_tpu.models.vae import VAE, init_vae_params
+from multidisttorch_tpu.models.vae import VAE, init_vae_params, vae_tp_shardings

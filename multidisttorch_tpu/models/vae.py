@@ -82,3 +82,38 @@ def init_vae_params(rng: jax.Array, model: VAE, batch_size: int = 1):
     """Initialize parameters with a dummy batch (flax idiom)."""
     dummy = jnp.zeros((batch_size, model.input_dim), jnp.float32)
     return model.init({"params": rng, "reparam": rng}, dummy)
+
+
+def vae_tp_shardings(trial):
+    """Megatron-style tensor-parallel shardings for the VAE param tree.
+
+    For a 2-D ``(data, model)`` trial submesh (``setup_groups(...,
+    model_parallel=m)``): the wide hidden layers split over the model
+    axis in column/row pairs — ``fc1``/``fc3`` column-parallel (output
+    features sharded, so the hidden activations are sharded), ``fc21``/
+    ``fc22``/``fc4`` row-parallel (input features sharded; XLA's SPMD
+    partitioner inserts the ``psum`` that completes each pair's matmul).
+    The reference has no tensor parallelism at all (SURVEY.md §2c); this
+    is the capability the MXU/ICI design makes nearly free.
+
+    Requires ``hidden_dim % trial.model_size == 0``. Returns a pytree of
+    ``NamedSharding`` matching ``{'params': ...}``-less param trees (the
+    output of ``model.init(...)['params']``).
+    """
+    from multidisttorch_tpu.parallel.mesh import MODEL_AXIS
+
+    col = {
+        "kernel": trial.sharding(None, MODEL_AXIS),
+        "bias": trial.sharding(MODEL_AXIS),
+    }
+    row = {
+        "kernel": trial.sharding(MODEL_AXIS, None),
+        "bias": trial.sharding(),
+    }
+    return {
+        "fc1": dict(col),
+        "fc21": dict(row),
+        "fc22": dict(row),
+        "fc3": dict(col),
+        "fc4": dict(row),
+    }

@@ -15,6 +15,7 @@ from multidisttorch_tpu.parallel.collectives import (
 )
 from multidisttorch_tpu.parallel.mesh import (
     DATA_AXIS,
+    MODEL_AXIS,
     TrialMesh,
     device_world,
     global_mesh,

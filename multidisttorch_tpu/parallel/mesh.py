@@ -33,6 +33,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # Axis name used for the data-parallel dimension of every trial submesh.
 DATA_AXIS = "data"
+# Axis name for the optional model/tensor-parallel dimension (2-D submeshes).
+MODEL_AXIS = "model"
 
 
 def device_world(devices: Optional[Sequence[jax.Device]] = None) -> tuple[int, int]:
@@ -80,6 +82,16 @@ class TrialMesh:
         return int(self.mesh.devices.size)
 
     @property
+    def data_size(self) -> int:
+        """Extent of the data-parallel axis (== ``size`` on 1-D groups)."""
+        return int(self.mesh.shape[DATA_AXIS])
+
+    @property
+    def model_size(self) -> int:
+        """Extent of the model-parallel axis (1 on 1-D groups)."""
+        return int(dict(self.mesh.shape).get(MODEL_AXIS, 1))
+
+    @property
     def is_local_member(self) -> bool:
         """Whether this process owns any device of the group.
 
@@ -124,6 +136,12 @@ class TrialMesh:
         """Replicate across the group (model/optimizer state, DDP-style)."""
         return NamedSharding(self.mesh, P())
 
+    def sharding(self, *spec) -> NamedSharding:
+        """Arbitrary ``PartitionSpec`` over this group's mesh axes —
+        e.g. ``trial.sharding(None, MODEL_AXIS)`` for a column-sharded
+        weight on a 2-D (data × model) submesh."""
+        return NamedSharding(self.mesh, P(*spec))
+
     def device_put(self, tree, sharding: Optional[NamedSharding] = None):
         """Place a pytree onto this group's devices (replicated by default)."""
         return jax.device_put(
@@ -142,6 +160,7 @@ def setup_groups(
     devices: Optional[Sequence[jax.Device]] = None,
     *,
     allow_uneven: bool = False,
+    model_parallel: int = 1,
 ) -> list[TrialMesh]:
     """Carve the device world into ``num_groups`` contiguous disjoint groups.
 
@@ -154,7 +173,12 @@ def setup_groups(
     - a non-divisible world raises ``ValueError`` unless
       ``allow_uneven=True`` explicitly opts into dropping the remainder
       devices (the reference silently orphans them and then hangs on its
-      world barriers — quirk Q5).
+      world barriers — quirk Q5);
+    - ``model_parallel=m`` makes each group a 2-D ``(data, model)``
+      submesh of shape ``(k/m, m)`` for within-trial tensor parallelism
+      (beyond the reference, which is DP-only — SURVEY.md §2c). The
+      model axis occupies the *fastest-varying* device positions so TP
+      collectives ride adjacent ICI links.
     """
     devs = list(jax.devices()) if devices is None else list(devices)
     world = len(devs)
@@ -174,9 +198,24 @@ def setup_groups(
             "allow_uneven=True to deliberately drop the remainder."
         )
 
+    if model_parallel < 1:
+        raise ValueError(f"model_parallel must be >= 1, got {model_parallel}")
+    if per_group % model_parallel:
+        raise ValueError(
+            f"group size {per_group} does not divide into model_parallel="
+            f"{model_parallel} (each group needs a full (data, model) grid)"
+        )
+
     groups = []
     for g in range(num_groups):
         ranks = tuple(range(g * per_group, (g + 1) * per_group))
-        submesh = Mesh(np.array([devs[r] for r in ranks]), (DATA_AXIS,))
+        grid = np.array([devs[r] for r in ranks])
+        if model_parallel == 1:
+            submesh = Mesh(grid, (DATA_AXIS,))
+        else:
+            submesh = Mesh(
+                grid.reshape(per_group // model_parallel, model_parallel),
+                (DATA_AXIS, MODEL_AXIS),
+            )
         groups.append(TrialMesh(group_id=g, mesh=submesh, global_ranks=ranks))
     return groups

@@ -4,4 +4,5 @@ from multidisttorch_tpu.train.steps import (
     make_eval_step,
     make_sample_step,
     make_train_step,
+    state_shardings,
 )

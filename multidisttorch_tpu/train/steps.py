@@ -53,25 +53,65 @@ def create_train_state(
     model: VAE,
     tx: optax.GradientTransformation,
     rng: jax.Array,
+    param_shardings: Any = None,
 ) -> TrainState:
-    """Initialize params on host, place replicated on the trial submesh.
+    """Initialize params on host, place them on the trial submesh.
 
     The analog of ``VAE().to(device)`` + DDP's initial parameter
     broadcast (``vae-hpo.py:129-130``) — except there is no broadcast:
-    placement with a replicated sharding materializes identical copies on
-    every member device.
+    placement with a sharding materializes the right shard/copy on every
+    member device. Default is DDP-style full replication;
+    ``param_shardings`` (a pytree of ``NamedSharding`` matching the
+    param tree, e.g. ``models.vae.vae_tp_shardings``) instead shards
+    weights over the submesh's model axis, and the optimizer state is
+    initialized *eagerly* so computation-follows-data gives each Adam
+    moment its weight's sharding — no hand-written moment shardings.
+    (Do NOT jit the init: jit constant-folds the zeros and drops the
+    sharding.)
     """
     variables = model.init(
         {"params": rng, "reparam": rng},
         jnp.zeros((1, model.input_dim), jnp.float32),
     )
     params = variables["params"]
-    state = TrainState(
-        params=params,
-        opt_state=tx.init(params),
-        step=jnp.zeros((), jnp.int32),
+    if param_shardings is None:
+        state = TrainState(
+            params=params,
+            opt_state=tx.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+        return trial.device_put(state)
+
+    from jax.sharding import NamedSharding
+
+    params = jax.device_put(params, param_shardings)
+    # Eager init: computation-follows-data gives each Adam moment its
+    # weight's sharding (a jit'd init would constant-fold the zeros and
+    # drop it). Scalar leaves with no input dependence (Adam's count)
+    # come back single-device — pin those replicated on the submesh.
+    opt_state = tx.init(params)
+    opt_state = jax.tree.map(
+        lambda x: (
+            x
+            if isinstance(getattr(x, "sharding", None), NamedSharding)
+            else trial.device_put(x)
+        ),
+        opt_state,
     )
-    return trial.device_put(state)
+    return TrainState(
+        params=params,
+        opt_state=opt_state,
+        step=jax.device_put(
+            jnp.zeros((), jnp.int32), trial.replicated_sharding
+        ),
+    )
+
+
+def state_shardings(state: TrainState) -> TrainState:
+    """The concrete sharding of every leaf of a placed ``TrainState`` —
+    pass to :func:`make_train_step` to pin a tensor-parallel state's
+    layout across steps (no layout drift, no resharding)."""
+    return jax.tree.map(lambda x: x.sharding, state)
 
 
 def make_train_step(
@@ -81,6 +121,7 @@ def make_train_step(
     *,
     beta: float = 1.0,
     use_fused_loss: bool = False,
+    shardings: Any = None,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
     """Build the compiled train step for one trial submesh.
 
@@ -92,6 +133,12 @@ def make_train_step(
     (``ops/pallas_elbo.py``, forward + custom-VJP backward); default off
     because XLA's own fusion is already competitive and composes with
     the surrounding matmuls.
+
+    ``shardings`` (from :func:`state_shardings` on a tensor-parallel
+    state) pins the state layout in and out of the step, so a 2-D
+    (data × model) trial runs Megatron-style: batch split over ``data``,
+    weights split over ``model``, and GSPMD inserts the activation
+    psums + gradient reductions over the right ICI axes.
     """
     repl = trial.replicated_sharding
     data = trial.batch_sharding
@@ -145,10 +192,11 @@ def make_train_step(
         metrics = {"loss_sum": (loss * n).astype(jnp.float32)}
         return new_state, metrics
 
+    state_sh = repl if shardings is None else shardings
     return jax.jit(
         step_fn,
-        in_shardings=(repl, data, repl),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sh, data, repl),
+        out_shardings=(state_sh, repl),
         donate_argnums=(0,),
     )
 

@@ -1,0 +1,128 @@
+"""2-D (data × model) trial submeshes: Megatron-style tensor parallelism
+within a trial — a capability beyond the reference (SURVEY.md §2c lists
+TP as absent there), validated against the 1-D data-parallel path.
+
+Runs on 8 virtual CPU devices (tests/conftest.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from multidisttorch_tpu.models.vae import VAE, vae_tp_shardings
+from multidisttorch_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    setup_groups,
+)
+from multidisttorch_tpu.train.steps import (
+    create_train_state,
+    make_train_step,
+    state_shardings,
+)
+
+
+def test_2d_carving_shapes_and_disjointness():
+    groups = setup_groups(2, model_parallel=2)
+    assert len(groups) == 2
+    seen = set()
+    for g in groups:
+        assert g.size == 4
+        assert g.data_size == 2
+        assert g.model_size == 2
+        assert dict(g.mesh.shape) == {DATA_AXIS: 2, MODEL_AXIS: 2}
+        ids = {d.id for d in g.devices}
+        assert not (ids & seen)
+        seen |= ids
+    assert len(seen) == 8
+
+
+def test_model_parallel_must_divide_group():
+    with pytest.raises(ValueError, match="model_parallel"):
+        setup_groups(2, model_parallel=3)  # group of 4, mp=3
+    with pytest.raises(ValueError, match="model_parallel"):
+        setup_groups(1, model_parallel=0)
+
+
+def test_1d_groups_report_trivial_model_axis():
+    (g,) = setup_groups(1)
+    assert g.model_size == 1
+    assert g.data_size == g.size == 8
+
+
+def test_tp_params_are_actually_sharded():
+    (g,) = setup_groups(1, model_parallel=4)  # 2 data x 4 model
+    model = VAE(hidden_dim=32, latent_dim=8)
+    state = create_train_state(
+        g, model, optax.adam(1e-3), jax.random.key(0),
+        param_shardings=vae_tp_shardings(g),
+    )
+    fc1 = state.params["fc1"]["kernel"]
+    # column-parallel: (784, 32) split into (784, 8) shards on the model axis
+    assert fc1.shape == (784, 32)
+    assert fc1.addressable_shards[0].data.shape == (784, 8)
+    # Adam moments inherit the weight sharding (eager init,
+    # computation-follows-data)
+    mu_fc1 = state.opt_state[0].mu["fc1"]["kernel"]
+    assert mu_fc1.addressable_shards[0].data.shape == (784, 8)
+    # row-parallel consumer: (32, 8) split into (8, 8) shards
+    fc21 = state.params["fc21"]["kernel"]
+    assert fc21.addressable_shards[0].data.shape == (8, 8)
+
+
+def _train_losses(model_parallel: int, steps: int = 4) -> list[float]:
+    if model_parallel == 1:
+        (g,) = setup_groups(1)
+        shardings = None
+        state = create_train_state(g, VAE(hidden_dim=32, latent_dim=8),
+                                   optax.adam(1e-3), jax.random.key(0))
+    else:
+        (g,) = setup_groups(1, model_parallel=model_parallel)
+        model = VAE(hidden_dim=32, latent_dim=8)
+        state = create_train_state(
+            g, model, optax.adam(1e-3), jax.random.key(0),
+            param_shardings=vae_tp_shardings(g),
+        )
+        shardings = state_shardings(state)
+    model = VAE(hidden_dim=32, latent_dim=8)
+    step = make_train_step(g, model, optax.adam(1e-3), shardings=shardings)
+    batch = jnp.asarray(
+        np.random.default_rng(0).uniform(0, 1, (16, 784)).astype(np.float32)
+    )
+    batch = jax.device_put(batch, g.batch_sharding)
+    losses = []
+    for i in range(steps):
+        state, m = step(state, batch, jax.random.fold_in(jax.random.key(7), i))
+        losses.append(float(m["loss_sum"]))
+    return losses
+
+
+def test_tp_training_matches_data_parallel():
+    # Same seeds, same data: a (2 data x 4 model) trial must optimize
+    # identically to the 8-wide pure-DP trial (up to reduction order).
+    dp = _train_losses(1)
+    tp = _train_losses(4)
+    np.testing.assert_allclose(dp, tp, rtol=2e-4)
+
+
+def test_tp_state_layout_is_stable_across_steps():
+    (g,) = setup_groups(1, model_parallel=2)
+    model = VAE(hidden_dim=32, latent_dim=8)
+    tx = optax.adam(1e-3)
+    state = create_train_state(
+        g, model, tx, jax.random.key(0),
+        param_shardings=vae_tp_shardings(g),
+    )
+    sh = state_shardings(state)
+    step = make_train_step(g, model, tx, shardings=sh)
+    batch = jax.device_put(
+        jnp.zeros((16, 784), jnp.float32), g.batch_sharding
+    )
+    state, _ = step(state, batch, jax.random.key(1))
+    # output layout identical to input layout — no drift, no reshard
+    assert jax.tree.all(
+        jax.tree.map(
+            lambda a, s: a.sharding == s, state.params, sh.params
+        )
+    )
