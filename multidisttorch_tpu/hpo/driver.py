@@ -168,6 +168,7 @@ class _TrialRun:
         # so a resumed run replays the exact remaining stream.
         self._ckpt_path = os.path.join(self.out_dir, "state.msgpack")
         self._ckpt_thread: Optional[threading.Thread] = None
+        self._ckpt_error: Optional[BaseException] = None
         self._start_epoch = 1
         if resume:
             meta_path = self._ckpt_path + ".json"
@@ -202,6 +203,19 @@ class _TrialRun:
                     self.state = restore_state(
                         self.state, self._ckpt_path, trial
                     )
+                    restored_step = int(jax.device_get(self.state.step))
+                    if "step" in meta and restored_step != int(meta["step"]):
+                        raise ValueError(
+                            f"resume: trial {cfg.trial_id} checkpoint is "
+                            f"skewed — state.msgpack is at optimizer step "
+                            f"{restored_step} but the metadata sidecar "
+                            f"claims step {meta['step']} (epoch {done}). "
+                            "A crash likely landed between the two "
+                            "checkpoint file replaces; delete "
+                            f"{self._ckpt_path}* to restart this trial "
+                            "from scratch rather than silently re-train "
+                            "an already-applied epoch"
+                        )
                     self._start_epoch = done + 1
                     self.result.history = list(meta.get("history", []))
                     if self.result.history:
@@ -216,6 +230,29 @@ class _TrialRun:
     def _log(self, *args):
         if self._verbose:
             log0(*args, trial=self.trial)
+
+    def _write_ckpt(self, host_state, meta: dict) -> None:
+        """Background checkpoint write. ``result.checkpoint`` is set only
+        after the (atomic) write succeeds, so a failed write can never be
+        reported as a valid checkpoint; failures are re-raised on the
+        next :meth:`_join_ckpt` and flow through the trial's normal
+        failure isolation."""
+        try:
+            save_state(host_state, self._ckpt_path, metadata=meta)
+            self.result.checkpoint = self._ckpt_path
+        except BaseException as e:  # re-raised at the next join
+            self._ckpt_error = e
+
+    def _join_ckpt(self) -> None:
+        if self._ckpt_thread is not None:
+            self._ckpt_thread.join()
+            self._ckpt_thread = None
+        if self._ckpt_error is not None:
+            e, self._ckpt_error = self._ckpt_error, None
+            raise RuntimeError(
+                f"trial {self.cfg.trial_id}: checkpoint write to "
+                f"{self._ckpt_path} failed"
+            ) from e
 
     def run(self) -> Iterator[None]:
         cfg = self.cfg
@@ -317,24 +354,28 @@ class _TrialRun:
                 meta = {
                     **asdict(cfg),
                     "completed_epochs": epoch,
+                    # Optimizer-step count at this epoch boundary: resume
+                    # cross-checks it against the restored state so a
+                    # crash landing between the two atomic replaces
+                    # (state newer than sidecar) is detected, not
+                    # silently re-trained.
+                    "step": int(host_state.step),
                     "history": list(self.result.history),
                 }
-                if self._ckpt_thread is not None:
-                    self._ckpt_thread.join()
+                self._join_ckpt()
                 self._ckpt_thread = threading.Thread(
-                    target=save_state,
-                    args=(host_state, self._ckpt_path),
-                    kwargs={"metadata": meta},
-                    daemon=True,
+                    target=self._write_ckpt,
+                    args=(host_state, meta),
+                    # Non-daemon: interpreter exit waits for the write
+                    # (atexit joins it), so a crash elsewhere in the
+                    # sweep can't kill a checkpoint mid-flight.
+                    daemon=False,
                 )
                 self._ckpt_thread.start()
-                self.result.checkpoint = self._ckpt_path
 
         # drain the pipeline so wall-clock covers real completion
         jax.block_until_ready(self.state.params)
-        if self._ckpt_thread is not None:
-            self._ckpt_thread.join()
-            self._ckpt_thread = None
+        self._join_ckpt()
         self.result.wall_s = time.time() - t0
         self.result.steps = step_no
         os.makedirs(self.out_dir, exist_ok=True)
@@ -508,6 +549,14 @@ def run_hpo(
                 run.result.error = f"{type(e).__name__}: {e}"
                 results[i] = run.result
                 del active[g.group_id]
+                # Drain any in-flight checkpoint write before freeing the
+                # submesh: run_hpo must not return while a writer thread
+                # is still mutating result.checkpoint, and a failed write
+                # must surface in the error, not vanish with the thread.
+                try:
+                    run._join_ckpt()
+                except Exception as ce:  # noqa: BLE001
+                    run.result.error += f"; also: {type(ce).__name__}: {ce}"
                 if not resilient:
                     raise
                 log0(

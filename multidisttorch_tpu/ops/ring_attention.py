@@ -63,15 +63,12 @@ def _ring_attention_local(q, k, v, *, axis_name, num_devices, causal, scale):
     b, _, h, d = q.shape
     # The carry starts as constants but becomes device-varying through
     # the loop body; shard_map's VMA typing requires the initial carry
-    # to carry the axis annotation already (pcast to 'varying'; older
-    # JAX spells it pvary).
-    if hasattr(jax.lax, "pcast"):
-        _vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
-    else:  # pragma: no cover
-        _vary = lambda x: jax.lax.pvary(x, axis_name)
-    m0 = _vary(jnp.full((b, h, t_local), -jnp.inf, jnp.float32))
-    l0 = _vary(jnp.zeros((b, h, t_local), jnp.float32))
-    acc0 = _vary(jnp.zeros((b, t_local, h, d), jnp.float32))
+    # to carry the axis annotation already.
+    from multidisttorch_tpu.parallel.collectives import pvary
+
+    m0 = pvary(jnp.full((b, h, t_local), -jnp.inf, jnp.float32), axis_name)
+    l0 = pvary(jnp.zeros((b, h, t_local), jnp.float32), axis_name)
+    acc0 = pvary(jnp.zeros((b, t_local, h, d), jnp.float32), axis_name)
 
     def body(step, carry):
         k_blk, v_blk, m, l, acc = carry

@@ -31,6 +31,21 @@ from jax.sharding import Mesh, PartitionSpec as P
 from multidisttorch_tpu.parallel.mesh import DATA_AXIS, TrialMesh
 
 
+def pvary(x, axis_names):
+    """Annotate ``x`` as device-varying over ``axis_names`` under
+    ``shard_map``'s varying-axis (VMA) typing.
+
+    Needed when a loop carry starts as a mesh-invariant constant but
+    becomes device-varying through the body (ppermute, axis_index, shard
+    data) — the initial carry must already hold the annotation. Wraps
+    the JAX API spelling drift: ``jax.lax.pcast(..., to="varying")``
+    (current) vs ``jax.lax.pvary`` (older).
+    """
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis_names, to="varying")
+    return jax.lax.pvary(x, axis_names)  # pragma: no cover
+
+
 @lru_cache(maxsize=None)
 def _gather_fn(mesh: Mesh):
     # check_vma=False: the gathered result is device-invariant by

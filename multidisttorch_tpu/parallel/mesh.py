@@ -35,6 +35,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 # Axis name for the optional model/tensor-parallel dimension (2-D submeshes).
 MODEL_AXIS = "model"
+# Axis name for the optional pipeline-stage dimension (parallel/pipeline.py).
+PIPE_AXIS = "pipe"
 
 
 def device_world(devices: Optional[Sequence[jax.Device]] = None) -> tuple[int, int]:
@@ -90,6 +92,12 @@ class TrialMesh:
     def model_size(self) -> int:
         """Extent of the model-parallel axis (1 on 1-D groups)."""
         return int(dict(self.mesh.shape).get(MODEL_AXIS, 1))
+
+    @property
+    def pipe_size(self) -> int:
+        """Extent of the pipeline-stage axis (1 unless carved with
+        ``pipeline_parallel > 1``)."""
+        return int(dict(self.mesh.shape).get(PIPE_AXIS, 1))
 
     @property
     def is_local_member(self) -> bool:
@@ -161,6 +169,7 @@ def setup_groups(
     *,
     allow_uneven: bool = False,
     model_parallel: int = 1,
+    pipeline_parallel: int = 1,
 ) -> list[TrialMesh]:
     """Carve the device world into ``num_groups`` contiguous disjoint groups.
 
@@ -179,6 +188,12 @@ def setup_groups(
       (beyond the reference, which is DP-only — SURVEY.md §2c). The
       model axis occupies the *fastest-varying* device positions so TP
       collectives ride adjacent ICI links.
+    - ``pipeline_parallel=p`` adds a ``pipe`` axis (see
+      ``parallel/pipeline.py``) between ``data`` and ``model``:
+      each group becomes a ``(k/(p*m), p[, m])`` grid. Pipe-axis
+      neighbors are ``m`` device positions apart — adjacent when
+      ``m == 1`` — so GPipe's stage-to-stage ppermute hops stay on
+      short ICI paths.
     """
     devs = list(jax.devices()) if devices is None else list(devices)
     world = len(devs)
@@ -200,22 +215,35 @@ def setup_groups(
 
     if model_parallel < 1:
         raise ValueError(f"model_parallel must be >= 1, got {model_parallel}")
-    if per_group % model_parallel:
+    if pipeline_parallel < 1:
         raise ValueError(
-            f"group size {per_group} does not divide into model_parallel="
-            f"{model_parallel} (each group needs a full (data, model) grid)"
+            f"pipeline_parallel must be >= 1, got {pipeline_parallel}"
         )
+    inner = model_parallel * pipeline_parallel
+    if per_group % inner:
+        raise ValueError(
+            f"group size {per_group} does not divide into pipeline_parallel="
+            f"{pipeline_parallel} x model_parallel={model_parallel} (each "
+            "group needs a full (data, pipe, model) grid)"
+        )
+
+    # Axis layout: model fastest-varying (adjacent ICI for TP
+    # collectives), then pipe, then data. Size-1 pipe/model axes are
+    # dropped so the default carve stays the 1-D (data,) mesh.
+    dims = [
+        (DATA_AXIS, per_group // inner),
+        (PIPE_AXIS, pipeline_parallel),
+        (MODEL_AXIS, model_parallel),
+    ]
+    kept = [(name, n) for name, n in dims if n > 1 or name == DATA_AXIS]
 
     groups = []
     for g in range(num_groups):
         ranks = tuple(range(g * per_group, (g + 1) * per_group))
         grid = np.array([devs[r] for r in ranks])
-        if model_parallel == 1:
-            submesh = Mesh(grid, (DATA_AXIS,))
-        else:
-            submesh = Mesh(
-                grid.reshape(per_group // model_parallel, model_parallel),
-                (DATA_AXIS, MODEL_AXIS),
-            )
+        submesh = Mesh(
+            grid.reshape(tuple(n for _, n in kept)),
+            tuple(name for name, _ in kept),
+        )
         groups.append(TrialMesh(group_id=g, mesh=submesh, global_ranks=ranks))
     return groups

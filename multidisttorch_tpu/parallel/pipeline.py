@@ -1,0 +1,187 @@
+"""Pipeline parallelism: stage-sharded models via collective microbatching.
+
+The reference framework is DP-only (SURVEY.md §2c — pipeline parallelism
+is "absent from all 448 lines"), but a TPU framework at its scale must
+let one trial's model exceed one chip. This module implements GPipe-style
+pipeline parallelism the SPMD way: every device runs the *same* jitted
+program under ``shard_map``; the stage dimension of the weights is
+sharded over a ``pipe`` mesh axis, microbatches march through the stages
+with non-cyclic ``jax.lax.ppermute`` neighbor hops (ICI-adjacent by
+construction — see ``setup_groups(pipeline_parallel=...)``), and the
+whole schedule is a single differentiable ``lax.scan``, so ``jax.grad``
+of a loss on the pipeline output *is* the backward pipeline — no
+hand-written backward schedule, no recompilation per stage.
+
+Schedule: the classic GPipe fill/steady/drain loop — with M microbatches
+and S stages, the scan runs ``M + S - 1`` ticks; stage 0 injects
+microbatch ``t`` at tick ``t``, stage ``S-1`` emits microbatch
+``t-(S-1)`` at tick ``t``. Bubble fraction ``(S-1)/(M+S-1)`` — pick
+``num_microbatches >> num_stages`` to amortize, exactly as in the GPipe
+paper. Composes with data parallelism: on a ``(data, pipe)`` submesh the
+batch dimension is additionally sharded over ``data`` and XLA reduces
+gradients over both axes.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from multidisttorch_tpu.parallel.mesh import DATA_AXIS, PIPE_AXIS, TrialMesh
+
+
+def _resolve_mesh(trial: TrialMesh | Mesh) -> Mesh:
+    return trial.mesh if isinstance(trial, TrialMesh) else trial
+
+
+def stage_params_sharding(trial: TrialMesh | Mesh) -> NamedSharding:
+    """Sharding for stacked per-stage weights: leading (stage) axis split
+    over the ``pipe`` mesh axis, so each device holds exactly its own
+    stage's parameters."""
+    mesh = _resolve_mesh(trial)
+    return NamedSharding(mesh, P(PIPE_AXIS))
+
+
+def _pipeline_local(
+    stage_params,
+    batch,
+    *,
+    stage_fn: Callable,
+    num_stages: int,
+    num_microbatches: int,
+    pipe_axis: str,
+    vary_axes: tuple[str, ...],
+):
+    """Per-device body under shard_map.
+
+    ``stage_params`` leaves arrive with a leading stage axis of local
+    extent 1 (their global leading axis is sharded over ``pipe``);
+    ``batch`` is this device's data shard, replicated across the pipe
+    axis (every stage sees it; only stage 0 reads it).
+    """
+    my_params = jax.tree.map(lambda x: x[0], stage_params)
+    stage_id = jax.lax.axis_index(pipe_axis)
+    is_first = stage_id == 0
+    is_last = stage_id == num_stages - 1
+
+    n = batch.shape[0]
+    mb = n // num_microbatches
+    micro = batch.reshape((num_microbatches, mb) + batch.shape[1:])
+
+    # Probe the stage output shape once (abstractly — no FLOPs at runtime)
+    # so the carry/output buffers can be allocated. Pipeline stages must
+    # be shape-preserving in the activation (equal-width stages), the
+    # standard GPipe restriction that makes the ppermute well-typed.
+    out_aval = jax.eval_shape(stage_fn, my_params, micro[0])
+    if out_aval.shape != micro[0].shape:
+        raise ValueError(
+            f"pipeline stages must preserve activation shape; stage maps "
+            f"{micro[0].shape} -> {out_aval.shape}"
+        )
+
+    # Carries start as constants but become device-varying through the
+    # loop (pipe via ppermute/axis_index, data via the batch shard —
+    # but NOT model, over which stages are replicated); annotate up
+    # front (shard_map VMA typing).
+    from multidisttorch_tpu.parallel.collectives import pvary
+
+    state0 = pvary(jnp.zeros(micro[0].shape, out_aval.dtype), vary_axes)
+    out0 = pvary(jnp.zeros(micro.shape, out_aval.dtype), vary_axes)
+
+    # Non-cyclic shift: stage i hands its activation to stage i+1; stage
+    # S-1's send is dropped, stage 0 receives zeros (and ignores them).
+    shift = [(i, i + 1) for i in range(num_stages - 1)]
+
+    def tick(carry, t):
+        state, outs = carry
+        inj = micro[jnp.clip(t, 0, num_microbatches - 1)]
+        x = jnp.where(is_first, inj.astype(state.dtype), state)
+        y = stage_fn(my_params, x)
+        out_idx = t - (num_stages - 1)
+        valid = jnp.logical_and(is_last, out_idx >= 0)
+        slot = jnp.clip(out_idx, 0, num_microbatches - 1)
+        prev = jax.lax.dynamic_index_in_dim(outs, slot, keepdims=False)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, jnp.where(valid, y, prev), slot, axis=0
+        )
+        state = jax.lax.ppermute(y, pipe_axis, shift)
+        return (state, outs), None
+
+    ticks = jnp.arange(num_microbatches + num_stages - 1)
+    (_, outs), _ = jax.lax.scan(tick, (state0, out0), ticks)
+
+    # Only the last stage holds real outputs; psum over the pipe axis
+    # broadcasts them (everyone else contributes zeros), making the
+    # result pipe-invariant so it can leave the shard_map replicated.
+    outs = jax.lax.psum(jnp.where(is_last, outs, jnp.zeros_like(outs)), pipe_axis)
+    return outs.reshape((n,) + outs.shape[2:])
+
+
+def pipeline_apply(
+    trial: TrialMesh | Mesh,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    *,
+    num_microbatches: int,
+) -> Callable[[Any, jax.Array], jax.Array]:
+    """Build a pipelined forward ``apply(stage_params, batch) -> out``.
+
+    - ``stage_fn(params_one_stage, x) -> y`` is the per-stage compute; it
+      must preserve the activation shape (equal-width stages).
+    - ``stage_params`` is a pytree whose every leaf has leading axis
+      ``num_stages``; place it with :func:`stage_params_sharding` so each
+      pipe-axis device owns one stage.
+    - ``batch`` has leading axis divisible by ``num_microbatches`` (per
+      data shard, if the submesh also has a ``data`` axis).
+
+    The returned function is pure and differentiable — wrap it in a loss
+    and ``jax.grad``/``jax.jit`` exactly like any other forward. Under
+    jit, GSPMD additionally reduces gradients over the ``data`` axis,
+    giving DP x PP from one program.
+    """
+    mesh = _resolve_mesh(trial)
+    if PIPE_AXIS not in mesh.shape:
+        raise ValueError(
+            f"mesh has no '{PIPE_AXIS}' axis (axes: {tuple(mesh.shape)}); "
+            "carve one with setup_groups(..., pipeline_parallel=S)"
+        )
+    num_stages = int(mesh.shape[PIPE_AXIS])
+    has_data = DATA_AXIS in mesh.shape
+    batch_spec = P(DATA_AXIS) if has_data else P()
+
+    def apply(stage_params, batch):
+        n_leading = jax.tree.leaves(stage_params)[0].shape[0]
+        if n_leading != num_stages:
+            raise ValueError(
+                f"stage_params leading axis {n_leading} != pipe axis "
+                f"extent {num_stages}"
+            )
+        return jax.shard_map(
+            partial(
+                _pipeline_local,
+                stage_fn=stage_fn,
+                num_stages=num_stages,
+                num_microbatches=num_microbatches,
+                pipe_axis=PIPE_AXIS,
+                vary_axes=(
+                    ((DATA_AXIS,) if has_data else ()) + (PIPE_AXIS,)
+                ),
+            ),
+            mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P(PIPE_AXIS), stage_params), batch_spec),
+            out_specs=batch_spec,
+        )(stage_params, batch)
+
+    return apply
+
+
+def sequential_reference(stage_fn, stage_params, batch):
+    """Single-device reference: run the stages back to back (for tests)."""
+    x = batch
+    num_stages = jax.tree.leaves(stage_params)[0].shape[0]
+    for s in range(num_stages):
+        x = stage_fn(jax.tree.map(lambda p: p[s], stage_params), x)
+    return x

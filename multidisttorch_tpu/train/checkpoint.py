@@ -22,14 +22,26 @@ from multidisttorch_tpu.parallel.mesh import TrialMesh
 
 
 def save_state(state: Any, path: str, *, metadata: Optional[dict] = None) -> str:
-    """Serialize a state pytree (host-side) to ``path`` (msgpack)."""
+    """Serialize a state pytree (host-side) to ``path`` (msgpack).
+
+    Writes are atomic (tmp file + ``os.replace``): a crash mid-write —
+    including the interpreter exiting while a background checkpoint
+    thread is running — can never leave a torn ``state.msgpack`` that
+    breaks a later ``resume``. The state file lands before the metadata
+    sidecar, so a reader never sees metadata describing a state that
+    isn't there yet.
+    """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     host_state = jax.device_get(state)
-    with open(path, "wb") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
         f.write(serialization.to_bytes(host_state))
+    os.replace(tmp, path)
     if metadata is not None:
-        with open(path + ".json", "w") as f:
+        meta_tmp = path + ".json.tmp"
+        with open(meta_tmp, "w") as f:
             json.dump(metadata, f, indent=2, default=str)
+        os.replace(meta_tmp, path + ".json")
     return path
 
 

@@ -255,3 +255,68 @@ def test_elastic_shard_across_trials_partitions_by_group(tmp_path, data):
     )
     # each group's shard is 64 of 128 rows -> 4 batches of 16 per trial
     assert all(r.steps == 4 for r in results)
+
+
+def test_checkpoint_write_failure_fails_trial_not_sweep(
+    tmp_path, data, monkeypatch
+):
+    """A failed background checkpoint write must surface as a trial
+    failure (not be silently swallowed by the writer thread), and the
+    trial must not advertise a checkpoint it never wrote."""
+    import multidisttorch_tpu.hpo.driver as drv
+
+    train, _ = data
+
+    real_save = drv.save_state
+
+    def failing_save(state, path, **kw):
+        if "trial-1" in path:
+            raise OSError("disk full")
+        return real_save(state, path, **kw)
+
+    monkeypatch.setattr(drv, "save_state", failing_save)
+    configs = [_small_cfg(0), _small_cfg(1)]
+    results = run_hpo(
+        configs, train, None, out_dir=str(tmp_path), verbose=False,
+        save_images=False, resilient=True,
+    )
+    statuses = {r.trial_id: r.status for r in results}
+    assert statuses == {0: "completed", 1: "failed"}
+    failed = next(r for r in results if r.trial_id == 1)
+    assert "checkpoint write" in failed.error
+    assert failed.checkpoint == ""
+    ok = next(r for r in results if r.trial_id == 0)
+    assert ok.checkpoint and os.path.exists(ok.checkpoint)
+
+
+def test_checkpoint_files_are_atomic_no_tmp_left(tmp_path, data):
+    train, _ = data
+    run_hpo(
+        [_small_cfg(0)], train, None, out_dir=str(tmp_path),
+        verbose=False, save_images=False,
+    )
+    ckpt_dir = tmp_path / "trial-0"
+    names = {p.name for p in ckpt_dir.iterdir()}
+    assert "state.msgpack" in names and "state.msgpack.json" in names
+    assert not any(n.endswith(".tmp") for n in names)
+
+
+def test_resume_detects_state_metadata_skew(tmp_path, data):
+    """A crash between the state-file and sidecar replaces leaves the
+    state one epoch ahead of the metadata; resume must refuse, not
+    silently re-train the already-applied epoch."""
+    train, _ = data
+    run_hpo(
+        [_small_cfg(0, epochs=2)], train, None, out_dir=str(tmp_path),
+        verbose=False, save_images=False,
+    )
+    meta_path = tmp_path / "trial-0" / "state.msgpack.json"
+    meta = json.loads(meta_path.read_text())
+    meta["completed_epochs"] -= 1  # sidecar now one epoch behind the state
+    meta["step"] -= 8
+    meta_path.write_text(json.dumps(meta))
+    with pytest.raises(ValueError, match="skewed"):
+        run_hpo(
+            [_small_cfg(0, epochs=3)], train, None, out_dir=str(tmp_path),
+            verbose=False, save_images=False, resume=True,
+        )

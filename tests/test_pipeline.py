@@ -1,0 +1,163 @@
+"""Pipeline parallelism: numerical parity with the sequential model,
+training through the pipeline, and DP x PP composition.
+
+The reference has no pipeline parallelism (SURVEY.md §2c); these tests
+validate the from-scratch GPipe-style implementation in
+``parallel/pipeline.py`` on the 8-virtual-device harness.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multidisttorch_tpu.parallel.mesh import (
+    DATA_AXIS,
+    PIPE_AXIS,
+    setup_groups,
+)
+from multidisttorch_tpu.parallel.pipeline import (
+    pipeline_apply,
+    sequential_reference,
+    stage_params_sharding,
+)
+
+WIDTH = 16
+
+
+def mlp_stage(params, x):
+    """One equal-width residual MLP stage: x + relu(x @ w + b)."""
+    return x + jax.nn.relu(x @ params["w"] + params["b"])
+
+
+def make_stacked_params(num_stages, key, width=WIDTH):
+    kw, kb = jax.random.split(key)
+    return {
+        "w": jax.random.normal(kw, (num_stages, width, width)) * 0.1,
+        "b": jax.random.normal(kb, (num_stages, width)) * 0.01,
+    }
+
+
+def test_mesh_carve_with_pipe_axis():
+    (trial,) = setup_groups(1, pipeline_parallel=4)
+    assert trial.pipe_size == 4
+    assert trial.data_size == 2
+    assert trial.model_size == 1
+    assert dict(trial.mesh.shape) == {DATA_AXIS: 2, PIPE_AXIS: 4}
+    # pipe neighbors are adjacent device positions (model_parallel=1)
+    grid = trial.mesh.devices
+    assert [d.id for d in grid[0]] == [0, 1, 2, 3]
+
+
+def test_pipeline_matches_sequential():
+    (trial,) = setup_groups(2, pipeline_parallel=4)[:1]
+    params = make_stacked_params(4, jax.random.key(0))
+    params = jax.device_put(params, stage_params_sharding(trial))
+    batch = jax.random.normal(jax.random.key(1), (8, WIDTH))
+
+    apply = pipeline_apply(trial, mlp_stage, num_microbatches=4)
+    got = jax.jit(apply)(params, batch)
+    want = sequential_reference(mlp_stage, jax.device_get(params), batch)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_pipeline_grads_match_sequential():
+    (trial,) = setup_groups(1, pipeline_parallel=8)
+    params = make_stacked_params(8, jax.random.key(2))
+    params = jax.device_put(params, stage_params_sharding(trial))
+    batch = jax.random.normal(jax.random.key(3), (16, WIDTH))
+    target = jax.random.normal(jax.random.key(4), (16, WIDTH))
+
+    apply = pipeline_apply(trial, mlp_stage, num_microbatches=4)
+
+    def pipe_loss(p):
+        return jnp.mean((apply(p, batch) - target) ** 2)
+
+    def seq_loss(p):
+        return jnp.mean(
+            (sequential_reference(mlp_stage, p, batch) - target) ** 2
+        )
+
+    g_pipe = jax.jit(jax.grad(pipe_loss))(params)
+    g_seq = jax.grad(seq_loss)(jax.device_get(params))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        jax.device_get(g_pipe),
+        g_seq,
+    )
+
+
+def test_pipeline_training_decreases_loss_dp_x_pp():
+    """Train a stage-sharded MLP on a (data=2, pipe=4) submesh: gradients
+    flow through the ppermute schedule and are reduced over the data
+    axis by GSPMD — DP x PP from one jitted program."""
+    import optax
+
+    (trial,) = setup_groups(1, pipeline_parallel=4)
+    assert trial.data_size == 2 and trial.pipe_size == 4
+    params = make_stacked_params(4, jax.random.key(5))
+    params = jax.device_put(params, stage_params_sharding(trial))
+    batch = jax.random.normal(jax.random.key(6), (32, WIDTH))
+    target = jnp.tanh(batch @ jax.random.normal(jax.random.key(7), (WIDTH, WIDTH)))
+
+    apply = pipeline_apply(trial, mlp_stage, num_microbatches=8)
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state):
+        def loss_fn(p):
+            return jnp.mean((apply(p, batch) - target) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    losses = []
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7, losses
+    # stage weights actually live one-per-pipe-device
+    shard = params["w"].addressable_shards[0]
+    assert shard.data.shape[0] == 1
+
+
+def test_pipeline_rejects_shape_changing_stage():
+    (trial,) = setup_groups(1, pipeline_parallel=4)
+    params = {"w": jnp.zeros((4, WIDTH, WIDTH // 2))}
+    batch = jnp.zeros((8, WIDTH))
+    apply = pipeline_apply(
+        trial, lambda p, x: x @ p["w"], num_microbatches=2
+    )
+    with pytest.raises(ValueError, match="preserve activation shape"):
+        jax.jit(apply)(params, batch)
+
+
+def test_pipeline_rejects_wrong_stage_count():
+    (trial,) = setup_groups(1, pipeline_parallel=4)
+    params = make_stacked_params(3, jax.random.key(0))
+    apply = pipeline_apply(trial, mlp_stage, num_microbatches=2)
+    with pytest.raises(ValueError, match="leading axis 3"):
+        apply(params, jnp.zeros((8, WIDTH)))
+
+
+def test_pipeline_requires_pipe_axis():
+    (trial,) = setup_groups(1)
+    with pytest.raises(ValueError, match="no 'pipe' axis"):
+        pipeline_apply(trial, mlp_stage, num_microbatches=2)
+
+
+def test_three_axis_carve_dp_pp_tp():
+    """(data, pipe, model) 3-D carve: 8 = 2 x 2 x 2."""
+    (trial,) = setup_groups(1, pipeline_parallel=2, model_parallel=2)
+    assert dict(trial.mesh.shape) == {
+        DATA_AXIS: 2,
+        PIPE_AXIS: 2,
+        "model": 2,
+    }
+    assert (trial.data_size, trial.pipe_size, trial.model_size) == (2, 2, 2)
