@@ -21,22 +21,32 @@ import warnings
 
 warnings.filterwarnings("ignore")
 
+import os
+
 import jax
+
+# The environment's sitecustomize may pre-import jax with a TPU plugin
+# pinned; honor an explicit JAX_PLATFORMS override (same trick as
+# tests/conftest.py) so the concurrency mode can run on virtual CPU
+# devices via XLA_FLAGS=--xla_force_host_platform_device_count=N.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import jax.numpy as jnp
 import numpy as np
 import optax
 
 BATCH = 128
 HIDDEN, LATENT = 400, 20
-WARMUP_STEPS = 10
-MEASURE_STEPS = 200
+CHUNK_STEPS = 100  # inner lax.scan steps per dispatch (make_multi_step)
+MEASURE_CHUNKS = 10
 TORCH_MEASURE_STEPS = 30
 
 
 def bench_ours() -> float:
     from multidisttorch_tpu.models.vae import VAE
     from multidisttorch_tpu.parallel.mesh import setup_groups
-    from multidisttorch_tpu.train.steps import create_train_state, make_train_step
+    from multidisttorch_tpu.train.steps import create_train_state, make_multi_step
 
     ndev = len(jax.devices())
     (trial,) = setup_groups(1)
@@ -46,24 +56,30 @@ def bench_ours() -> float:
     model = VAE(hidden_dim=HIDDEN, latent_dim=LATENT, dtype=dtype)
     tx = optax.adam(1e-3)
     state = create_train_state(trial, model, tx, jax.random.key(0))
-    step = make_train_step(trial, model, tx)
+    # Dispatch-amortized training: the device runs CHUNK_STEPS optimizer
+    # updates per host round-trip (lax.scan over the step body) — the
+    # TPU-idiomatic shape of the reference's per-batch loop
+    # (vae-hpo.py:67-74), where each iteration crossed the host/device
+    # boundary twice.
+    multi = make_multi_step(trial, model, tx)
 
-    batch_np = (
-        np.random.default_rng(0).uniform(0, 1, (BATCH, 784)).astype(np.float32)
+    batches_np = np.random.default_rng(0).uniform(
+        0, 1, (CHUNK_STEPS, BATCH, 784)
+    ).astype(np.float32)
+    batches = jax.device_put(
+        jnp.asarray(batches_np), trial.sharding(None, "data")
     )
-    batch = jax.device_put(jnp.asarray(batch_np), trial.batch_sharding)
     key = jax.random.key(1)
 
-    for i in range(WARMUP_STEPS):
-        state, m = step(state, batch, jax.random.fold_in(key, i))
+    state, _ = multi(state, batches, key)  # compile + warmup
     jax.block_until_ready(state.params)
 
     t0 = time.perf_counter()
-    for i in range(MEASURE_STEPS):
-        state, m = step(state, batch, jax.random.fold_in(key, WARMUP_STEPS + i))
+    for i in range(MEASURE_CHUNKS):
+        state, m = multi(state, batches, jax.random.fold_in(key, i))
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
-    return MEASURE_STEPS * BATCH / dt / ndev
+    return MEASURE_CHUNKS * CHUNK_STEPS * BATCH / dt / ndev
 
 
 def bench_reference_torch() -> float:
@@ -120,47 +136,54 @@ def bench_concurrency(num_trials: int) -> dict:
     trials."""
     from multidisttorch_tpu.models.vae import VAE
     from multidisttorch_tpu.parallel.mesh import setup_groups
-    from multidisttorch_tpu.train.steps import create_train_state, make_train_step
+    from multidisttorch_tpu.train.steps import create_train_state, make_multi_step
 
     groups = setup_groups(num_trials)
     dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
     model = VAE(hidden_dim=HIDDEN, latent_dim=LATENT, dtype=dtype)
     tx = optax.adam(1e-3)
-    batch_np = (
-        np.random.default_rng(0).uniform(0, 1, (BATCH, 784)).astype(np.float32)
-    )
+    batches_np = np.random.default_rng(0).uniform(
+        0, 1, (CHUNK_STEPS, BATCH, 784)
+    ).astype(np.float32)
     key = jax.random.key(1)
 
     def setup_trial(g):
         state = create_train_state(g, model, tx, jax.random.key(g.group_id))
-        step = make_train_step(g, model, tx)
-        batch = jax.device_put(jnp.asarray(batch_np), g.batch_sharding)
-        return {"state": state, "step": step, "batch": batch}
+        step = make_multi_step(g, model, tx)
+        batches = jax.device_put(
+            jnp.asarray(batches_np), g.sharding(None, "data")
+        )
+        return {"state": state, "step": step, "batches": batches}
 
     trials = [setup_trial(g) for g in groups]
 
-    def run_steps(active, nsteps):
-        for i in range(nsteps):
+    def run_chunks(active, nchunks):
+        # Interleaved async dispatch: each trial's chunks queue on its own
+        # disjoint submesh; the host never blocks until the end.
+        for i in range(nchunks):
             for t in active:
                 t["state"], _ = t["step"](
-                    t["state"], t["batch"], jax.random.fold_in(key, i)
+                    t["state"], t["batches"], jax.random.fold_in(key, i)
                 )
         for t in active:
             jax.block_until_ready(t["state"].params)
 
     # warmup all compilations
-    run_steps(trials, WARMUP_STEPS)
+    run_chunks(trials, 1)
 
     # trial 0 alone on its submesh
     t0 = time.perf_counter()
-    run_steps(trials[:1], MEASURE_STEPS)
-    alone_sps = MEASURE_STEPS * BATCH / (time.perf_counter() - t0)
+    run_chunks(trials[:1], MEASURE_CHUNKS)
+    alone_sps = (
+        MEASURE_CHUNKS * CHUNK_STEPS * BATCH / (time.perf_counter() - t0)
+    )
 
     # all trials concurrently
     t0 = time.perf_counter()
-    run_steps(trials, MEASURE_STEPS)
+    run_chunks(trials, MEASURE_CHUNKS)
     dt = time.perf_counter() - t0
-    per_trial_sps = MEASURE_STEPS * BATCH / dt  # each trial did MEASURE_STEPS
+    # each trial did MEASURE_CHUNKS * CHUNK_STEPS steps
+    per_trial_sps = MEASURE_CHUNKS * CHUNK_STEPS * BATCH / dt
 
     return {
         "num_trials": num_trials,

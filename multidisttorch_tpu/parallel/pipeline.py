@@ -149,7 +149,10 @@ def pipeline_apply(
             "carve one with setup_groups(..., pipeline_parallel=S)"
         )
     num_stages = int(mesh.shape[PIPE_AXIS])
+    if num_microbatches < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got {num_microbatches}")
     has_data = DATA_AXIS in mesh.shape
+    data_size = int(mesh.shape[DATA_AXIS]) if has_data else 1
     batch_spec = P(DATA_AXIS) if has_data else P()
 
     def apply(stage_params, batch):
@@ -158,6 +161,13 @@ def pipeline_apply(
             raise ValueError(
                 f"stage_params leading axis {n_leading} != pipe axis "
                 f"extent {num_stages}"
+            )
+        shard_n, rem = divmod(batch.shape[0], data_size)
+        if rem or shard_n % num_microbatches:
+            raise ValueError(
+                f"batch leading axis {batch.shape[0]} must divide into "
+                f"{data_size} data shard(s) x {num_microbatches} "
+                "microbatches of equal size"
             )
         return jax.shard_map(
             partial(

@@ -2,6 +2,7 @@ from multidisttorch_tpu.train.steps import (
     TrainState,
     create_train_state,
     make_eval_step,
+    make_multi_step,
     make_sample_step,
     make_train_step,
     state_shardings,

@@ -31,7 +31,7 @@ from flax import struct
 
 from multidisttorch_tpu.models.vae import VAE
 from multidisttorch_tpu.ops.losses import elbo_loss_sum
-from multidisttorch_tpu.parallel.mesh import TrialMesh
+from multidisttorch_tpu.parallel.mesh import DATA_AXIS, TrialMesh
 
 
 @struct.dataclass
@@ -114,34 +114,15 @@ def state_shardings(state: TrainState) -> TrainState:
     return jax.tree.map(lambda x: x.sharding, state)
 
 
-def make_train_step(
+def _build_step_fn(
     trial: TrialMesh,
     model: VAE,
     tx: optax.GradientTransformation,
-    *,
-    beta: float = 1.0,
-    use_fused_loss: bool = False,
-    shardings: Any = None,
+    beta: float,
+    use_fused_loss: bool,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
-    """Build the compiled train step for one trial submesh.
-
-    Returns ``step(state, batch, rng) -> (state, metrics)`` where
-    ``batch`` is the trial-global batch (sharded over the submesh data
-    axis on entry), and ``metrics['loss_sum']`` is the summed negative
-    ELBO over the batch (reference logging contract, ``vae-hpo.py:73``).
-    ``use_fused_loss`` swaps in the single-pass Pallas ELBO kernel
-    (``ops/pallas_elbo.py``, forward + custom-VJP backward); default off
-    because XLA's own fusion is already competitive and composes with
-    the surrounding matmuls.
-
-    ``shardings`` (from :func:`state_shardings` on a tensor-parallel
-    state) pins the state layout in and out of the step, so a 2-D
-    (data × model) trial runs Megatron-style: batch split over ``data``,
-    weights split over ``model``, and GSPMD inserts the activation
-    psums + gradient reductions over the right ICI axes.
-    """
-    repl = trial.replicated_sharding
-    data = trial.batch_sharding
+    """The un-jitted train-step body shared by :func:`make_train_step`
+    (one step per dispatch) and :func:`make_multi_step` (scan-fused)."""
     loss_impl = elbo_loss_sum
     if use_fused_loss:
         from jax.sharding import PartitionSpec as _P
@@ -192,10 +173,92 @@ def make_train_step(
         metrics = {"loss_sum": (loss * n).astype(jnp.float32)}
         return new_state, metrics
 
+    return step_fn
+
+
+def make_train_step(
+    trial: TrialMesh,
+    model: VAE,
+    tx: optax.GradientTransformation,
+    *,
+    beta: float = 1.0,
+    use_fused_loss: bool = False,
+    shardings: Any = None,
+) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
+    """Build the compiled train step for one trial submesh.
+
+    Returns ``step(state, batch, rng) -> (state, metrics)`` where
+    ``batch`` is the trial-global batch (sharded over the submesh data
+    axis on entry), and ``metrics['loss_sum']`` is the summed negative
+    ELBO over the batch (reference logging contract, ``vae-hpo.py:73``).
+    ``use_fused_loss`` swaps in the single-pass Pallas ELBO kernel
+    (``ops/pallas_elbo.py``, forward + custom-VJP backward); default off
+    because XLA's own fusion is already competitive and composes with
+    the surrounding matmuls.
+
+    ``shardings`` (from :func:`state_shardings` on a tensor-parallel
+    state) pins the state layout in and out of the step, so a 2-D
+    (data × model) trial runs Megatron-style: batch split over ``data``,
+    weights split over ``model``, and GSPMD inserts the activation
+    psums + gradient reductions over the right ICI axes.
+    """
+    repl = trial.replicated_sharding
+    data = trial.batch_sharding
+    step_fn = _build_step_fn(trial, model, tx, beta, use_fused_loss)
     state_sh = repl if shardings is None else shardings
     return jax.jit(
         step_fn,
         in_shardings=(state_sh, data, repl),
+        out_shardings=(state_sh, repl),
+        donate_argnums=(0,),
+    )
+
+
+def make_multi_step(
+    trial: TrialMesh,
+    model: VAE,
+    tx: optax.GradientTransformation,
+    *,
+    beta: float = 1.0,
+    use_fused_loss: bool = False,
+    shardings: Any = None,
+) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
+    """K chained train steps in ONE dispatch, via ``lax.scan``.
+
+    At the reference's workload size (a 784-400-20 MLP VAE at batch 128,
+    ``/root/reference/vae-hpo.py:19-45,183``) a single train step is a
+    few microseconds of MXU time, so a per-step Python dispatch — the
+    reference's loop shape (``vae-hpo.py:67-74``) and
+    :func:`make_train_step`'s — is host-bound. The TPU-first fix is to
+    keep the loop on device: scan the step body over a stacked batch so
+    the chip runs K optimizer updates per host round-trip.
+
+    Returns ``multi_step(state, batches, rng) -> (state, metrics)`` where
+    ``batches`` has shape ``(K, batch, ...)`` — sharded over the submesh
+    data axis on dim 1 — and ``metrics['loss_sum']`` has shape ``(K,)``
+    (one summed negative ELBO per inner step, same logging contract as
+    :func:`make_train_step`). ``rng`` is split into K per-step keys
+    inside the compiled program.
+    """
+    step_fn = _build_step_fn(trial, model, tx, beta, use_fused_loss)
+    repl = trial.replicated_sharding
+    batches_sh = trial.sharding(None, DATA_AXIS)
+    state_sh = repl if shardings is None else shardings
+
+    def multi_fn(state: TrainState, batches: jax.Array, rng: jax.Array):
+        rngs = jax.random.split(rng, batches.shape[0])
+
+        def body(s, xs):
+            b, r = xs
+            s, metrics = step_fn(s, b, r)
+            return s, metrics["loss_sum"]
+
+        state, losses = jax.lax.scan(body, state, (batches, rngs))
+        return state, {"loss_sum": losses}
+
+    return jax.jit(
+        multi_fn,
+        in_shardings=(state_sh, batches_sh, repl),
         out_shardings=(state_sh, repl),
         donate_argnums=(0,),
     )
