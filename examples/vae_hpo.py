@@ -49,6 +49,12 @@ def main():
         "--synthetic-size", type=int, default=None,
         help="rows for the synthetic fallback dataset (default: MNIST-sized)",
     )
+    parser.add_argument(
+        "--fused-steps", type=int, default=10,
+        help="train steps fused into one device dispatch via lax.scan "
+        "(default 10 = the log cadence; 1 reproduces the reference's "
+        "one-dispatch-per-batch loop shape)",
+    )
     args = parser.parse_args()
 
     nproc, pid = mdt.initialize_runtime()
@@ -71,6 +77,7 @@ def main():
             lr=args.lr,
             beta=args.beta,
             seed=g,
+            fused_steps=args.fused_steps,
         )
         for g in range(args.ngroups)
     ]

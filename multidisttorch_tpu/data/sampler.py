@@ -92,7 +92,7 @@ class TrialDataIterator:
             elif use_native:
                 raise RuntimeError("native fastloader unavailable")
 
-    def _put(self, rows: np.ndarray):
+    def _put(self, rows: np.ndarray, sharding=None):
         """Place a trial-global batch onto the submesh.
 
         Single-controller: one ``device_put`` with the batch sharding.
@@ -103,14 +103,19 @@ class TrialDataIterator:
         ``make_array_from_callback`` slices out only the rows of this
         process's addressable shards.
         """
+        sh = self.trial.batch_sharding if sharding is None else sharding
         if jax.process_count() == 1:
-            return jax.device_put(rows, self.trial.batch_sharding)
+            return jax.device_put(rows, sh)
         return jax.make_array_from_callback(
-            rows.shape, self.trial.batch_sharding, lambda idx: rows[idx]
+            rows.shape, sh, lambda idx: rows[idx]
         )
 
-    def epoch(self, epoch: int) -> Iterator:
-        """Iterate one epoch with a fresh (seed, epoch) permutation."""
+    def _host_batches(self, epoch: int) -> Iterator:
+        """Yield host-side ``(imgs_np, labels_np_or_None)`` batches in the
+        fresh (seed, epoch) permutation order — the single source of
+        batch production shared by :meth:`epoch` and
+        :meth:`epoch_chunks`, so their permutations and batch boundaries
+        can never drift apart."""
         rng = np.random.default_rng(
             np.random.SeedSequence([self.seed, epoch])
         )
@@ -127,22 +132,56 @@ class TrialDataIterator:
                 n = gatherer.start_epoch(perm, self.batch_size)
                 for _ in range(n):
                     imgs_np, labels_np = gatherer.next_batch()
-                    imgs = self._put(imgs_np)
-                    if self.with_labels:
-                        yield imgs, self._put(labels_np)
-                    else:
-                        yield imgs
+                    yield imgs_np, (labels_np if self.with_labels else None)
             finally:
                 gatherer.close()
             return
 
         for b in range(self.num_batches):
             idx = perm[b * self.batch_size : (b + 1) * self.batch_size]
-            imgs = self._put(self.dataset.images[idx])
+            yield self.dataset.images[idx], (
+                self.dataset.labels[idx] if self.with_labels else None
+            )
+
+    def epoch(self, epoch: int) -> Iterator:
+        """Iterate one epoch with a fresh (seed, epoch) permutation."""
+        for imgs_np, labels_np in self._host_batches(epoch):
+            imgs = self._put(imgs_np)
             if self.with_labels:
-                yield imgs, self._put(self.dataset.labels[idx])
+                yield imgs, self._put(labels_np)
             else:
                 yield imgs
+
+    def epoch_chunks(self, epoch: int, k: int) -> Iterator:
+        """Iterate one epoch as stacked ``(k, batch, ...)`` chunks.
+
+        The feed shape for ``make_multi_step``'s scan-fused dispatch:
+        same (seed, epoch) permutation and batch boundaries as
+        :meth:`epoch` (both consume :meth:`_host_batches`), but ``k``
+        consecutive batches arrive as one array placed with the chunk
+        sharding (dim 1 over the submesh data axis), so the driver pays
+        one host round-trip per ``k`` optimizer steps. Yields
+        ``(start_batch_index, chunk)`` (or ``(start, imgs, labels)``
+        with labels); the final chunk may hold fewer than ``k`` batches.
+        """
+        if k < 1:
+            raise ValueError(f"chunk size must be >= 1, got {k}")
+        from multidisttorch_tpu.parallel.mesh import DATA_AXIS
+
+        chunk_sh = self.trial.sharding(None, DATA_AXIS)
+        imgs_buf, labels_buf, start = [], [], 0
+        for i, (imgs_np, labels_np) in enumerate(self._host_batches(epoch)):
+            imgs_buf.append(imgs_np)
+            if self.with_labels:
+                labels_buf.append(labels_np)
+            if len(imgs_buf) == k or i == self.num_batches - 1:
+                out = self._put(np.stack(imgs_buf), chunk_sh)
+                if self.with_labels:
+                    yield start, out, self._put(np.stack(labels_buf), chunk_sh)
+                else:
+                    yield start, out
+                start = i + 1
+                imgs_buf, labels_buf = [], []
 
     @property
     def samples_per_epoch(self) -> int:

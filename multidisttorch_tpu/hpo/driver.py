@@ -56,6 +56,7 @@ from multidisttorch_tpu.train.checkpoint import restore_state, save_state
 from multidisttorch_tpu.train.steps import (
     create_train_state,
     make_eval_step,
+    make_multi_step,
     make_sample_step,
     make_train_step,
 )
@@ -77,6 +78,13 @@ class TrialConfig:
     hidden_dim: int = 400
     latent_dim: int = 20
     log_interval: int = 10  # reference train log cadence, vae-hpo.py:61
+    # Train steps fused into one device dispatch (make_multi_step's
+    # lax.scan). 1 = the reference's one-dispatch-per-batch loop shape;
+    # >1 amortizes host dispatch, the dominant cost at this model size.
+    # Changes the per-step RNG stream (keys are split per chunk instead
+    # of folded per step), so it participates in the resume
+    # config-match check like any other hyperparameter.
+    fused_steps: int = 1
 
 
 @dataclass
@@ -98,11 +106,13 @@ class TrialResult:
 class _TrialRun:
     """One trial's full lifecycle as a cooperative generator.
 
-    Each ``next()`` dispatches exactly one train step (async) and
-    returns; host-device syncs happen only at the reference's logging
-    cadence and at epoch boundaries. The generator shape is what makes
-    the no-barrier scheduling work: the driver interleaves ``next()``
-    across trials, so every submesh has work queued at all times.
+    Each ``next()`` dispatches one unit of training work async — a
+    single train step, or a chunk of ``cfg.fused_steps`` scan-fused
+    steps — and returns; host-device syncs happen only at the
+    reference's logging cadence and at epoch boundaries. The generator
+    shape is what makes the no-barrier scheduling work: the driver
+    interleaves ``next()`` across trials, so every submesh has work
+    queued at all times.
     """
 
     def __init__(
@@ -145,6 +155,11 @@ class _TrialRun:
             trial, model, tx, jax.random.key(cfg.seed)
         )
         self.train_step = make_train_step(trial, model, tx, beta=cfg.beta)
+        self.multi_step = (
+            make_multi_step(trial, model, tx, beta=cfg.beta)
+            if cfg.fused_steps > 1
+            else None
+        )
         self.eval_step = make_eval_step(trial, model, beta=cfg.beta)
         self.sample_step = make_sample_step(trial, model)
         self.train_iter = TrialDataIterator(
@@ -178,11 +193,21 @@ class _TrialRun:
                 # Guard against resuming under silently-changed
                 # hyperparameters: everything except the epoch target
                 # (extending epochs is the legitimate resume use) must
-                # match the checkpoint's saved config.
+                # match the checkpoint's saved config. Fields absent
+                # from an older checkpoint's sidecar compare against
+                # their TrialConfig default — a checkpoint written
+                # before a field existed was trained under its default.
+                from dataclasses import MISSING, fields as dc_fields
+
+                field_defaults = {
+                    f.name: f.default
+                    for f in dc_fields(TrialConfig)
+                    if f.default is not MISSING
+                }
                 saved = {
-                    k: meta[k]
+                    k: meta.get(k, field_defaults.get(k))
                     for k in asdict(cfg)
-                    if k != "epochs" and k in meta
+                    if k != "epochs" and (k in meta or k in field_defaults)
                 }
                 current = {k: v for k, v in asdict(cfg).items() if k != "epochs"}
                 if saved and saved != current:
@@ -270,28 +295,72 @@ class _TrialRun:
         step_no = int(jax.device_get(self.state.step))
         for epoch in range(self._start_epoch, cfg.epochs + 1):
             epoch_loss_sums = []
-            for i, batch in enumerate(self.train_iter.epoch(epoch)):
-                rng = jax.random.fold_in(self._key, step_no)
-                self.state, metrics = self.train_step(self.state, batch, rng)
-                step_no += 1
-                epoch_loss_sums.append(metrics["loss_sum"])  # device value
-                if i % cfg.log_interval == 0:
-                    # sync point for THIS trial only (reference logs
-                    # loss.item() here, vae-hpo.py:76-86)
-                    per_sample = float(metrics["loss_sum"]) / cfg.batch_size
-                    self._log(
-                        "Train Epoch: {} [{}/{} ({:.0f}%)]\tLoss: {:.6f}".format(
-                            epoch,
-                            i * cfg.batch_size,
-                            n_per_epoch,
-                            100.0 * i / self.train_iter.num_batches,
-                            per_sample,
-                        )
+
+            def log_batch(epoch, i, loss_sum):
+                # sync point for THIS trial only (reference logs
+                # loss.item() here, vae-hpo.py:76-86)
+                per_sample = float(loss_sum) / cfg.batch_size
+                self._log(
+                    "Train Epoch: {} [{}/{} ({:.0f}%)]\tLoss: {:.6f}".format(
+                        epoch,
+                        i * cfg.batch_size,
+                        n_per_epoch,
+                        100.0 * i / self.train_iter.num_batches,
+                        per_sample,
                     )
-                yield  # hand the host loop to the next trial
+                )
+
+            if self.multi_step is None:
+                for i, batch in enumerate(self.train_iter.epoch(epoch)):
+                    rng = jax.random.fold_in(self._key, step_no)
+                    self.state, metrics = self.train_step(
+                        self.state, batch, rng
+                    )
+                    step_no += 1
+                    epoch_loss_sums.append(metrics["loss_sum"])  # on device
+                    if i % cfg.log_interval == 0:
+                        log_batch(epoch, i, metrics["loss_sum"])
+                    yield  # hand the host loop to the next trial
+            else:
+                # Scan-fused dispatch: fused_steps optimizer updates per
+                # host round-trip. The log cadence is preserved exactly —
+                # the chunk's per-step losses are indexable, so the batch
+                # that would have logged in the per-step loop still does.
+                K = cfg.fused_steps
+                for item in self.train_iter.epoch_chunks(epoch, K):
+                    i0, chunk = item[0], item[1]
+                    c = chunk.shape[0]
+                    if c == K:
+                        rng = jax.random.fold_in(self._key, step_no)
+                        self.state, metrics = self.multi_step(
+                            self.state, chunk, rng
+                        )
+                        step_no += c
+                        losses = metrics["loss_sum"]  # (K,) on device
+                        epoch_loss_sums.append(losses)
+                        # Every batch index that would have logged in the
+                        # per-step loop still logs (there can be several
+                        # per chunk when log_interval < fused_steps).
+                        j = -(-i0 // cfg.log_interval) * cfg.log_interval
+                        while j < i0 + c:
+                            log_batch(epoch, j, losses[j - i0])
+                            j += cfg.log_interval
+                    else:
+                        # Tail shorter than the compiled chunk: step it
+                        # batch-by-batch (no extra compilation).
+                        for j in range(c):
+                            rng = jax.random.fold_in(self._key, step_no)
+                            self.state, metrics = self.train_step(
+                                self.state, chunk[j], rng
+                            )
+                            step_no += 1
+                            epoch_loss_sums.append(metrics["loss_sum"])
+                            if (i0 + j) % cfg.log_interval == 0:
+                                log_batch(epoch, i0 + j, metrics["loss_sum"])
+                    yield
 
             avg = float(
-                np.sum([float(s) for s in epoch_loss_sums])
+                np.sum([np.sum(np.asarray(s)) for s in epoch_loss_sums])
             ) / n_per_epoch
             self._log(
                 "====> Epoch: {} Average loss: {:.4f}".format(epoch, avg)

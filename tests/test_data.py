@@ -123,3 +123,46 @@ class TestTrialDataIterator:
         imgs, labels = next(iter(it.epoch(0)))
         assert imgs.shape == (16, 784)
         assert labels.shape == (16,)
+
+
+class TestEpochChunks:
+    def test_chunks_match_per_batch_epoch(self):
+        # Same permutation, same batch boundaries: chunk[j] must equal
+        # batch i0+j of the per-batch iterator for the same epoch.
+        ds = synthetic_mnist(80, seed=3)
+        trial = setup_groups(2)[0]
+        it = TrialDataIterator(ds, trial, 16, seed=5, use_native=False)
+        flat = [np.asarray(b) for b in it.epoch(2)]  # 5 batches
+        chunked = list(it.epoch_chunks(2, 2))  # 2+2+tail 1
+        assert [c[0] for c in chunked] == [0, 2, 4]
+        assert [c[1].shape[0] for c in chunked] == [2, 2, 1]
+        for i0, chunk in chunked:
+            for j in range(chunk.shape[0]):
+                np.testing.assert_array_equal(
+                    np.asarray(chunk[j]), flat[i0 + j]
+                )
+
+    def test_chunks_native_matches_numpy(self):
+        from multidisttorch_tpu.data import native
+
+        if not native.available():
+            pytest.skip("native fastloader not built")
+        ds = synthetic_mnist(64, seed=4)
+        trial = setup_groups(4)[1]
+        a = TrialDataIterator(ds, trial, 16, seed=7, use_native=False)
+        b = TrialDataIterator(ds, trial, 16, seed=7, use_native=True)
+        for (ia, ca), (ib, cb) in zip(a.epoch_chunks(1, 3), b.epoch_chunks(1, 3)):
+            assert ia == ib
+            np.testing.assert_array_equal(np.asarray(ca), np.asarray(cb))
+
+    def test_chunks_with_labels(self):
+        ds = synthetic_mnist(48, seed=2)
+        trial = setup_groups(8)[0]
+        it = TrialDataIterator(
+            ds, trial, 8, seed=1, with_labels=True, use_native=False
+        )
+        chunks = list(it.epoch_chunks(0, 4))
+        assert len(chunks) == 2  # 6 batches -> 4 + tail 2
+        i0, imgs, labels = chunks[0]
+        assert imgs.shape[0] == 4 and labels.shape[0] == 4
+        assert imgs.shape[1] == 8 and labels.shape[1] == 8

@@ -320,3 +320,102 @@ def test_resume_detects_state_metadata_skew(tmp_path, data):
             [_small_cfg(0, epochs=3)], train, None, out_dir=str(tmp_path),
             verbose=False, save_images=False, resume=True,
         )
+
+
+def test_fused_steps_sweep_matches_step_count(tmp_path, data):
+    # fused_steps > 1 dispatches chunks of K scan-fused steps (plus an
+    # unfused tail); step counts, history, and outputs must match the
+    # per-step mode's contract. 128/16 = 8 batches, K=3 -> chunks of
+    # 3+3, tail of 2.
+    train, test = data
+    configs = [_small_cfg(0, fused_steps=3, epochs=2), _small_cfg(1, fused_steps=3)]
+    results = run_hpo(
+        configs, train, test, out_dir=str(tmp_path), verbose=False
+    )
+    assert results[0].steps == 16 and results[1].steps == 8
+    for r in results:
+        assert np.isfinite(r.final_train_loss)
+        assert len(r.history) == r.config.epochs
+
+
+def test_fused_steps_loss_decreases(tmp_path, data):
+    train, _ = data
+    (r,) = run_hpo(
+        [_small_cfg(0, fused_steps=4, epochs=6)],
+        train,
+        None,
+        out_dir=str(tmp_path),
+        verbose=False,
+        save_images=False,
+        save_checkpoints=False,
+    )
+    first = r.history[0]["avg_train_loss"]
+    last = r.history[-1]["avg_train_loss"]
+    assert last < first
+
+
+def test_fused_steps_log_cadence_preserved(tmp_path, data, capsys):
+    # The batch indices that log in per-step mode must still log when
+    # chunked: log_interval=4 with K=3 over 8 batches -> batches 0 and 4.
+    train, _ = data
+    run_hpo(
+        [_small_cfg(0, fused_steps=3, log_interval=4)],
+        train,
+        None,
+        out_dir=str(tmp_path),
+        num_groups=1,
+        save_images=False,
+        save_checkpoints=False,
+    )
+    out = capsys.readouterr().out
+    assert "[0/128" in out and "[64/128" in out
+
+
+def test_fused_steps_logs_every_interval_when_smaller_than_chunk(
+    tmp_path, data, capsys
+):
+    # log_interval=2 < fused_steps=5 over 8 batches: per-step mode logs
+    # batches 0,2,4,6 — the chunked path must log all of them too.
+    train, _ = data
+    run_hpo(
+        [_small_cfg(0, fused_steps=5, log_interval=2)],
+        train,
+        None,
+        out_dir=str(tmp_path),
+        num_groups=1,
+        save_images=False,
+        save_checkpoints=False,
+    )
+    out = capsys.readouterr().out
+    for start in (0, 32, 64, 96):  # batch idx x 16 samples
+        assert f"[{start}/128" in out, f"missing log line for sample {start}"
+
+
+def test_resume_refuses_fused_steps_change_from_legacy_checkpoint(
+    tmp_path, data
+):
+    # A sidecar written before the fused_steps field existed must compare
+    # it against the TrialConfig default (1), so resuming with a
+    # different value is refused instead of silently re-training under a
+    # new RNG stream.
+    train, test = data
+    cfg = _small_cfg(0)
+    run_hpo([cfg], train, test, out_dir=str(tmp_path), num_groups=1,
+            verbose=False)
+    meta_path = os.path.join(str(tmp_path), "trial-0", "state.msgpack.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    del meta["fused_steps"]  # simulate a pre-fused_steps checkpoint
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+
+    with pytest.raises(ValueError, match="fused_steps"):
+        run_hpo(
+            [_small_cfg(0, fused_steps=4, epochs=2)],
+            train,
+            test,
+            out_dir=str(tmp_path),
+            num_groups=1,
+            verbose=False,
+            resume=True,
+        )
