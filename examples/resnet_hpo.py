@@ -27,7 +27,7 @@ from multidisttorch_tpu.models import ResNet18  # noqa: E402
 from multidisttorch_tpu.train.classifier import (  # noqa: E402
     create_classifier_state,
     make_classifier_eval_step,
-    make_classifier_train_step,
+    make_classifier_multi_step,
 )
 
 
@@ -38,6 +38,10 @@ def main():
     parser.add_argument("--ngroups", type=int, default=2)
     parser.add_argument("--base-channels", type=int, default=64)
     parser.add_argument("--synthetic-size", type=int, default=None)
+    parser.add_argument(
+        "--fused-steps", type=int, default=4,
+        help="train steps fused into one device dispatch via lax.scan",
+    )
     args = parser.parse_args()
 
     mdt.initialize_runtime()
@@ -63,7 +67,7 @@ def main():
                 "trial": g,
                 "lr": lr,
                 "state": state,
-                "step": make_classifier_train_step(g, model, tx),
+                "step": make_classifier_multi_step(g, model, tx),
                 "eval": make_classifier_eval_step(g, model),
                 "iter": TrialDataIterator(
                     train_data, g, args.batch_size,
@@ -73,15 +77,19 @@ def main():
         )
 
     # Cooperative round-robin across subgroups (same no-barrier execution
-    # model as hpo.driver.run_hpo).
+    # model as hpo.driver.run_hpo), one scan-fused chunk per dispatch.
+    # Chunks shorter than fused_steps (epoch tails) jit-compile once per
+    # distinct length and are then cached like any other shape.
     t0 = time.time()
     for epoch in range(args.epochs):
-        iters = [t["iter"].epoch(epoch) for t in trials]
+        iters = [
+            t["iter"].epoch_chunks(epoch, args.fused_steps) for t in trials
+        ]
         live = list(range(len(trials)))
         while live:
             for i in list(live):
                 try:
-                    images, labels = next(iters[i])
+                    _, images, labels = next(iters[i])
                 except StopIteration:
                     live.remove(i)
                     continue

@@ -152,6 +152,38 @@ class TrialDataIterator:
             else:
                 yield imgs
 
+    def _chunked(self, host_batches: Iterator, k: int, flush_tail: bool):
+        """Accumulate ``k`` host batches, stack, and place with the chunk
+        sharding (dim 1 over the data axis) — the single chunk-assembly
+        path under :meth:`epoch_chunks` and :meth:`stream_chunks`.
+        Yields ``(start_batch_index, imgs[, labels])``; a trailing
+        partial chunk is yielded only with ``flush_tail``.
+        """
+        if k < 1:
+            raise ValueError(f"chunk size must be >= 1, got {k}")
+        from multidisttorch_tpu.parallel.mesh import DATA_AXIS
+
+        chunk_sh = self.trial.sharding(None, DATA_AXIS)
+        imgs_buf, labels_buf, start = [], [], 0
+        for i, (imgs_np, labels_np) in enumerate(host_batches):
+            imgs_buf.append(imgs_np)
+            if self.with_labels:
+                labels_buf.append(labels_np)
+            if len(imgs_buf) == k:
+                out = self._put(np.stack(imgs_buf), chunk_sh)
+                if self.with_labels:
+                    yield start, out, self._put(np.stack(labels_buf), chunk_sh)
+                else:
+                    yield start, out
+                start = i + 1
+                imgs_buf, labels_buf = [], []
+        if imgs_buf and flush_tail:
+            out = self._put(np.stack(imgs_buf), chunk_sh)
+            if self.with_labels:
+                yield start, out, self._put(np.stack(labels_buf), chunk_sh)
+            else:
+                yield start, out
+
     def epoch_chunks(self, epoch: int, k: int) -> Iterator:
         """Iterate one epoch as stacked ``(k, batch, ...)`` chunks.
 
@@ -164,24 +196,28 @@ class TrialDataIterator:
         ``(start_batch_index, chunk)`` (or ``(start, imgs, labels)``
         with labels); the final chunk may hold fewer than ``k`` batches.
         """
-        if k < 1:
-            raise ValueError(f"chunk size must be >= 1, got {k}")
-        from multidisttorch_tpu.parallel.mesh import DATA_AXIS
+        yield from self._chunked(self._host_batches(epoch), k, flush_tail=True)
 
-        chunk_sh = self.trial.sharding(None, DATA_AXIS)
-        imgs_buf, labels_buf, start = [], [], 0
-        for i, (imgs_np, labels_np) in enumerate(self._host_batches(epoch)):
-            imgs_buf.append(imgs_np)
-            if self.with_labels:
-                labels_buf.append(labels_np)
-            if len(imgs_buf) == k or i == self.num_batches - 1:
-                out = self._put(np.stack(imgs_buf), chunk_sh)
-                if self.with_labels:
-                    yield start, out, self._put(np.stack(labels_buf), chunk_sh)
-                else:
-                    yield start, out
-                start = i + 1
-                imgs_buf, labels_buf = [], []
+    def stream_chunks(self, k: int, start_epoch: int = 0) -> Iterator:
+        """Endless stacked ``(k, batch, ...)`` chunks crossing epoch
+        boundaries (each epoch freshly permuted, same stream as
+        :meth:`epoch`).
+
+        The feed for *step-count-driven* loops — e.g. PBT generations of
+        N optimizer steps (``hpo/pbt.py``) — where epoch edges are
+        irrelevant and every chunk must be full so the scan-fused
+        dispatch compiles exactly once. Unlike :meth:`epoch_chunks`, no
+        batch-index bookkeeping: yields ``imgs`` (or ``(imgs, labels)``).
+        """
+
+        def endless():
+            epoch = start_epoch
+            while True:
+                yield from self._host_batches(epoch)
+                epoch += 1
+
+        for item in self._chunked(endless(), k, flush_tail=False):
+            yield item[1] if not self.with_labels else item[1:]
 
     @property
     def samples_per_epoch(self) -> int:

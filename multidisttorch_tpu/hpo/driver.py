@@ -131,6 +131,11 @@ class _TrialRun:
         model_builder=None,
         resume: bool = False,
     ):
+        if cfg.fused_steps < 1:
+            raise ValueError(
+                f"fused_steps must be >= 1, got {cfg.fused_steps} "
+                f"(trial {cfg.trial_id})"
+            )
         self.trial = trial
         self.cfg = cfg
         self.out_dir = os.path.join(out_dir, f"trial-{cfg.trial_id}")
@@ -297,6 +302,8 @@ class _TrialRun:
             epoch_loss_sums = []
 
             def log_batch(epoch, i, loss_sum):
+                if not self._verbose:
+                    return  # don't pay the device sync for a dropped line
                 # sync point for THIS trial only (reference logs
                 # loss.item() here, vae-hpo.py:76-86)
                 per_sample = float(loss_sum) / cfg.batch_size

@@ -36,7 +36,7 @@ from multidisttorch_tpu.train.steps import (
     TrainState,
     create_train_state,
     make_eval_step,
-    make_train_step,
+    make_multi_step,
 )
 from multidisttorch_tpu.utils.logging import log0
 
@@ -92,13 +92,17 @@ class _Member:
         self.state = create_train_state(
             trial, model, tx, jax.random.key(cfg.seed + member_id)
         )
-        self.train_step = make_train_step(trial, model, tx, beta=cfg.beta)
+        # One generation = one scan-fused dispatch of steps_per_generation
+        # optimizer updates (make_multi_step): the member's whole explore
+        # phase costs a single host round-trip.
+        self.multi_step = make_multi_step(trial, model, tx, beta=cfg.beta)
         self.eval_step = make_eval_step(
             trial, model, beta=cfg.beta, with_recon=False
         )
         self.train_iter = TrialDataIterator(
             train_data, trial, cfg.batch_size, seed=cfg.seed + member_id
         )
+        self._chunks = self.train_iter.stream_chunks(cfg.steps_per_generation)
         # eval batch must keep the per-device divisibility invariant
         eval_bs = min(cfg.batch_size, len(eval_data))
         eval_bs -= eval_bs % trial.data_size
@@ -108,23 +112,16 @@ class _Member:
                 f"{trial.data_size}-wide data axis"
             )
         self.eval_iter = TrialDataIterator(eval_data, trial, eval_bs, seed=0)
-        self._epoch = 0
-        self._batches = iter(())
         self._key = jax.random.key(1000 + member_id)
         self._step = 0
 
-    def next_batch(self):
-        try:
-            return next(self._batches)
-        except StopIteration:
-            self._batches = self.train_iter.epoch(self._epoch)
-            self._epoch += 1
-            return next(self._batches)
-
-    def one_step(self):
+    def run_generation(self):
+        """Dispatch one generation's explore phase (async): K fused
+        train steps on the next K batches of this member's stream."""
+        batches = next(self._chunks)
         rng = jax.random.fold_in(self._key, self._step)
-        self.state, m = self.train_step(self.state, self.next_batch(), rng)
-        self._step += 1
+        self.state, m = self.multi_step(self.state, batches, rng)
+        self._step += batches.shape[0]
         return m
 
     def eval_loss(self) -> float:
@@ -147,10 +144,12 @@ def run_pbt(
 ) -> PBTResult:
     """Run synchronous-generation PBT, one member per submesh.
 
-    Within a generation, members' train steps are dispatched round-robin
-    (all submeshes busy concurrently); the exploit/explore exchange at
-    generation boundaries is the only cross-trial coordination — and it
-    is host-side metadata + one device_put per exploited member.
+    A generation's explore phase is one scan-fused dispatch per member
+    (``steps_per_generation`` optimizer updates in a single host
+    round-trip, queued async on every submesh at once); the
+    exploit/explore exchange at generation boundaries is the only
+    cross-trial coordination — and it is host-side metadata + one
+    device_put per exploited member.
     """
     if jax.process_count() > 1:
         raise NotImplementedError(
@@ -186,10 +185,10 @@ def run_pbt(
     t0 = time.time()
 
     for gen in range(cfg.generations):
-        # --- explore phase: interleaved dispatch keeps all submeshes busy
-        for _ in range(cfg.steps_per_generation):
-            for m in members:
-                m.one_step()
+        # --- explore phase: one scan-fused dispatch per member puts a
+        # full generation of steps in flight on every submesh at once
+        for m in members:
+            m.run_generation()
 
         scores = {m.member_id: m.eval_loss() for m in members}
         ranked = sorted(members, key=lambda m: scores[m.member_id])

@@ -37,12 +37,11 @@ def create_classifier_state(
     return trial.device_put(state)
 
 
-def make_classifier_train_step(
-    trial: TrialMesh, model: Any, tx: optax.GradientTransformation
+def _build_classifier_step_fn(
+    model: Any, tx: optax.GradientTransformation
 ) -> Callable:
-    """``step(state, (images, labels)) -> (state, {loss, accuracy})``."""
-    repl = trial.replicated_sharding
-    data = trial.batch_sharding
+    """Un-jitted classifier step body, shared by the single-step and
+    scan-fused builders."""
 
     def step_fn(state: TrainState, images: jax.Array, labels: jax.Array):
         def loss_fn(params):
@@ -63,9 +62,51 @@ def make_classifier_train_step(
             "accuracy": acc,
         }
 
+    return step_fn
+
+
+def make_classifier_train_step(
+    trial: TrialMesh, model: Any, tx: optax.GradientTransformation
+) -> Callable:
+    """``step(state, images, labels) -> (state, {loss, accuracy})``."""
+    repl = trial.replicated_sharding
+    data = trial.batch_sharding
     return jax.jit(
-        step_fn,
+        _build_classifier_step_fn(model, tx),
         in_shardings=(repl, data, data),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+
+
+def make_classifier_multi_step(
+    trial: TrialMesh, model: Any, tx: optax.GradientTransformation
+) -> Callable:
+    """K chained classifier train steps in ONE dispatch (``lax.scan``) —
+    the labeled-data analog of ``train.steps.make_multi_step``.
+
+    ``multi_step(state, images, labels) -> (state, metrics)`` with
+    ``images``/``labels`` stacked as ``(K, batch, ...)`` (the sampler's
+    ``epoch_chunks``/``stream_chunks`` shapes, sharded over the data
+    axis on dim 1); metrics are per-step arrays of shape ``(K,)``.
+    """
+    from multidisttorch_tpu.parallel.mesh import DATA_AXIS
+
+    repl = trial.replicated_sharding
+    chunk = trial.sharding(None, DATA_AXIS)
+    step_fn = _build_classifier_step_fn(model, tx)
+
+    def multi_fn(state: TrainState, images: jax.Array, labels: jax.Array):
+        def body(s, xs):
+            s, m = step_fn(s, *xs)
+            return s, m
+
+        state, metrics = jax.lax.scan(body, state, (images, labels))
+        return state, metrics
+
+    return jax.jit(
+        multi_fn,
+        in_shardings=(repl, chunk, chunk),
         out_shardings=(repl, repl),
         donate_argnums=(0,),
     )

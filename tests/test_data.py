@@ -166,3 +166,20 @@ class TestEpochChunks:
         i0, imgs, labels = chunks[0]
         assert imgs.shape[0] == 4 and labels.shape[0] == 4
         assert imgs.shape[1] == 8 and labels.shape[1] == 8
+
+
+def test_stream_chunks_crosses_epoch_boundaries():
+    # 80 rows / bs 16 = 5 batches per epoch; chunks of 3 must keep
+    # coming past the epoch edge, matching the concatenated per-epoch
+    # streams batch for batch.
+    ds = synthetic_mnist(80, seed=9)
+    trial = setup_groups(4)[2]
+    it = TrialDataIterator(ds, trial, 16, seed=11, use_native=False)
+    stream = it.stream_chunks(3)
+    got = [np.asarray(next(stream)) for _ in range(4)]  # 12 batches
+    want = [np.asarray(b) for b in it.epoch(0)] + [
+        np.asarray(b) for b in it.epoch(1)
+    ] + [np.asarray(b) for b in it.epoch(2)]
+    flat_got = [batch for chunk in got for batch in chunk]
+    for a, b in zip(flat_got, want):
+        np.testing.assert_array_equal(a, b)

@@ -125,3 +125,41 @@ class TestResNet18:
         )
         assert 0.0 <= float(out["correct"]) <= 64.0
         assert np.isfinite(float(out["loss"]))
+
+
+class TestClassifierMultiStep:
+    def test_matches_sequential_steps(self):
+        from multidisttorch_tpu.train.classifier import (
+            make_classifier_multi_step,
+        )
+
+        model = ResNet18(num_classes=10, base_channels=4)
+        trial = setup_groups(4)[0]
+        tx = optax.adam(1e-3)
+        ds = synthetic_cifar10(96, seed=2)
+        it = TrialDataIterator(ds, trial, batch_size=16, with_labels=True, seed=3)
+
+        s_seq = create_classifier_state(trial, model, tx, jax.random.key(1))
+        step = make_classifier_train_step(trial, model, tx)
+        seq_losses = []
+        flat = list(it.epoch(0))[:3]
+        for images, labels in flat:
+            s_seq, m = step(s_seq, images, labels)
+            seq_losses.append(float(m["loss"]))
+
+        s_multi = create_classifier_state(trial, model, tx, jax.random.key(1))
+        multi = make_classifier_multi_step(trial, model, tx)
+        _, images, labels = next(it.epoch_chunks(0, 3))
+        s_multi, metrics = multi(s_multi, images, labels)
+
+        assert metrics["loss"].shape == (3,)
+        np.testing.assert_allclose(
+            np.asarray(metrics["loss"]), seq_losses, rtol=1e-5
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+            ),
+            s_multi.params,
+            s_seq.params,
+        )
