@@ -28,6 +28,7 @@ from multidisttorch_tpu.train.classifier import (  # noqa: E402
     create_classifier_state,
     make_classifier_eval_step,
     make_classifier_multi_step,
+    make_classifier_train_step,
 )
 
 
@@ -68,6 +69,7 @@ def main():
                 "lr": lr,
                 "state": state,
                 "step": make_classifier_multi_step(g, model, tx),
+                "tail_step": make_classifier_train_step(g, model, tx),
                 "eval": make_classifier_eval_step(g, model),
                 "iter": TrialDataIterator(
                     train_data, g, args.batch_size,
@@ -78,8 +80,9 @@ def main():
 
     # Cooperative round-robin across subgroups (same no-barrier execution
     # model as hpo.driver.run_hpo), one scan-fused chunk per dispatch.
-    # Chunks shorter than fused_steps (epoch tails) jit-compile once per
-    # distinct length and are then cached like any other shape.
+    # Epoch-tail chunks shorter than fused_steps run batch-by-batch
+    # through the single-step compile instead of triggering a second
+    # scan compilation for the odd length.
     t0 = time.time()
     for epoch in range(args.epochs):
         iters = [
@@ -94,7 +97,13 @@ def main():
                     live.remove(i)
                     continue
                 t = trials[i]
-                t["state"], m = t["step"](t["state"], images, labels)
+                if images.shape[0] == args.fused_steps:
+                    t["state"], m = t["step"](t["state"], images, labels)
+                else:
+                    for j in range(images.shape[0]):
+                        t["state"], m = t["tail_step"](
+                            t["state"], images[j], labels[j]
+                        )
                 t["last_metrics"] = m
 
     for t in trials:
