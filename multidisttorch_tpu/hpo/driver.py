@@ -486,6 +486,7 @@ def run_hpo(
     model_builder=None,
     resilient: bool = False,
     resume: bool = False,
+    profile_dir: Optional[str] = None,
 ) -> list[TrialResult]:
     """Run the configs over disjoint submeshes, concurrently, with no
     cross-trial synchronization.
@@ -513,8 +514,55 @@ def run_hpo(
     under ``{out_dir}/trial-{id}/`` (skipping fully-trained trials), so
     an interrupted sweep re-run completes only the remaining work.
 
+    ``profile_dir`` wraps the whole sweep in a JAX profiler trace
+    (TensorBoard/Perfetto-loadable, device timelines included on TPU) —
+    the tool for confirming submeshes stay busy and finding host-side
+    dispatch contention (SURVEY.md §7 "hard parts").
+
     Returns results for locally-run trials, in config order.
     """
+    if profile_dir is not None:
+        from multidisttorch_tpu.utils.profiling import profile_trace
+
+        trace_ctx = profile_trace(profile_dir)
+    else:
+        import contextlib
+
+        trace_ctx = contextlib.nullcontext()
+    with trace_ctx:
+        return _run_hpo_body(
+            configs,
+            train_data,
+            test_data,
+            groups=groups,
+            num_groups=num_groups,
+            out_dir=out_dir,
+            shard_across_trials=shard_across_trials,
+            save_images=save_images,
+            save_checkpoints=save_checkpoints,
+            verbose=verbose,
+            model_builder=model_builder,
+            resilient=resilient,
+            resume=resume,
+        )
+
+
+def _run_hpo_body(
+    configs,
+    train_data,
+    test_data,
+    *,
+    groups,
+    num_groups,
+    out_dir,
+    shard_across_trials,
+    save_images,
+    save_checkpoints,
+    verbose,
+    model_builder,
+    resilient,
+    resume,
+) -> list[TrialResult]:
     if groups is None:
         groups = setup_groups(
             num_groups if num_groups is not None else len(configs)

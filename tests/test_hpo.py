@@ -419,3 +419,22 @@ def test_resume_refuses_fused_steps_change_from_legacy_checkpoint(
             verbose=False,
             resume=True,
         )
+
+
+def test_profile_dir_writes_trace(tmp_path, data):
+    train, _ = data
+    prof = tmp_path / "prof"
+    run_hpo(
+        [_small_cfg(0)],
+        train,
+        None,
+        out_dir=str(tmp_path / "out"),
+        num_groups=1,
+        verbose=False,
+        save_images=False,
+        save_checkpoints=False,
+        profile_dir=str(prof),
+    )
+    # jax.profiler writes plugins/profile/<ts>/*.xplane.pb under the dir
+    found = list(prof.rglob("*.xplane.pb"))
+    assert found, f"no profiler artifacts under {prof}"
