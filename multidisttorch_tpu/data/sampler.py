@@ -143,6 +143,23 @@ class TrialDataIterator:
                 self.dataset.labels[idx] if self.with_labels else None
             )
 
+    def first_host_batch(self, epoch: int) -> np.ndarray:
+        """The epoch's first batch as a host array (images only).
+
+        For host-side consumers of batch *values* (e.g. the
+        reconstruction comparison grid): in multi-controller mode the
+        device batches are sharded across processes and cannot be
+        fetched whole, but the host permutation is deterministic on
+        every process, so this is the same data with no collective —
+        and no epoch-wide gather (a direct slice, bypassing the native
+        prefetcher, which would otherwise spin up a whole-epoch
+        background gather for one batch)."""
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, epoch])
+        )
+        perm = rng.permutation(self._indices)
+        return self.dataset.images[perm[: self.batch_size]]
+
     def epoch(self, epoch: int) -> Iterator:
         """Iterate one epoch with a fresh (seed, epoch) permutation."""
         for imgs_np, labels_np in self._host_batches(epoch):

@@ -145,7 +145,13 @@ class _TrialRun:
             config=cfg,
             out_dir=self.out_dir,
         )
-        self._save_images = save_images
+        # Artifacts (images, checkpoints, metrics.json) are written by
+        # exactly one process per group — on a shared filesystem,
+        # every-owner-writes would race identical files (Q4's
+        # multi-process half). Resume restores *state* on all owner
+        # processes; only the writer re-reads sidecar metadata.
+        self._is_writer = trial.is_writer_process
+        self._save_images = save_images and self._is_writer
         self._save_checkpoint = save_checkpoint
         self._verbose = verbose
         self._test_data = test_data
@@ -165,7 +171,13 @@ class _TrialRun:
             if cfg.fused_steps > 1
             else None
         )
-        self.eval_step = make_eval_step(trial, model, beta=cfg.beta)
+        # Reconstructions are materialized (and all-gathered back to
+        # replicated) only when images are wanted. Keyed on the uniform
+        # save_images argument, NOT the per-process writer-gated flag:
+        # all owner processes must compile the identical eval program.
+        self.eval_step = make_eval_step(
+            trial, model, beta=cfg.beta, with_recon=save_images
+        )
         self.sample_step = make_sample_step(trial, model)
         self.train_iter = TrialDataIterator(
             train_data,
@@ -180,6 +192,7 @@ class _TrialRun:
             if test_data is not None and len(test_data) >= cfg.batch_size
             else None
         )
+        self._first_test_batch = None
         self._key = jax.random.key(cfg.seed + 1)
 
         # Resume: per-epoch checkpoints carry (state, completed_epochs,
@@ -380,8 +393,18 @@ class _TrialRun:
                     out = self.eval_step(self.state, tbatch)
                     test_sum += float(out["loss_sum"])
                     test_n += tbatch.shape[0]
-                    if j == 0:
-                        first_batch = np.asarray(tbatch)
+                    if j == 0 and self._save_images:
+                        # batch values from the deterministic host stream
+                        # (the device batch is data-sharded and, on a
+                        # process-spanning submesh, not fetchable whole);
+                        # recon is replicated, hence fetchable anywhere.
+                        # The eval stream is always epoch 0, so the host
+                        # copy is constant — fetch it once.
+                        if self._first_test_batch is None:
+                            self._first_test_batch = (
+                                self.test_iter.first_host_batch(0)
+                            )
+                        first_batch = self._first_test_batch
                         first_recon = np.asarray(out["recon"])
                     yield
                 test_avg = test_sum / test_n
@@ -417,7 +440,7 @@ class _TrialRun:
 
             self.result.history.append(epoch_record)
             self.result.final_train_loss = avg
-            if self._save_checkpoint:
+            if self._save_checkpoint and self._is_writer:
                 # Per-epoch checkpoint = the resume boundary. Keep the
                 # scheduler loop responsive: start the device→host copy
                 # async, yield once so other trials keep dispatching,
@@ -454,20 +477,21 @@ class _TrialRun:
         self._join_ckpt()
         self.result.wall_s = time.time() - t0
         self.result.steps = step_no
-        os.makedirs(self.out_dir, exist_ok=True)
-        with open(os.path.join(self.out_dir, "metrics.json"), "w") as f:
-            json.dump(
-                {
-                    "trial_id": self.result.trial_id,
-                    "group_id": self.result.group_id,
-                    "config": asdict(cfg),
-                    "history": self.result.history,
-                    "wall_s": self.result.wall_s,
-                    "steps": self.result.steps,
-                },
-                f,
-                indent=2,
-            )
+        if self._is_writer:
+            os.makedirs(self.out_dir, exist_ok=True)
+            with open(os.path.join(self.out_dir, "metrics.json"), "w") as f:
+                json.dump(
+                    {
+                        "trial_id": self.result.trial_id,
+                        "group_id": self.result.group_id,
+                        "config": asdict(cfg),
+                        "history": self.result.history,
+                        "wall_s": self.result.wall_s,
+                        "steps": self.result.steps,
+                    },
+                    f,
+                    indent=2,
+                )
         self._log(f"Done. time: {self.result.wall_s:f}")
 
 

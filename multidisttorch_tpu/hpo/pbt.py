@@ -34,6 +34,7 @@ from multidisttorch_tpu.models.vae import VAE
 from multidisttorch_tpu.parallel.mesh import TrialMesh, setup_groups
 from multidisttorch_tpu.train.steps import (
     TrainState,
+    build_train_state,
     create_train_state,
     make_eval_step,
     make_multi_step,
@@ -66,11 +67,19 @@ class PBTResult:
     wall_s: float = 0.0
 
 
-def _set_lr(state: TrainState, lr: float) -> TrainState:
-    """Overwrite the injected learning rate inside the optimizer state."""
+def _set_lr(
+    state: TrainState, lr: float, trial: Optional[TrialMesh] = None
+) -> TrainState:
+    """Overwrite the injected learning rate inside the optimizer state.
+
+    With ``trial``, the new scalar is placed replicated on the trial's
+    submesh (required in multi-controller mode, where mixing a
+    process-local scalar into a pytree of multi-process global arrays
+    would fail at the next dispatch)."""
     opt = state.opt_state
     hp = dict(opt.hyperparams)
-    hp["learning_rate"] = jnp.asarray(lr, dtype=hp["learning_rate"].dtype)
+    new = jnp.asarray(lr, dtype=hp["learning_rate"].dtype)
+    hp["learning_rate"] = trial.device_put(new) if trial is not None else new
     return state.replace(opt_state=opt._replace(hyperparams=hp))
 
 
@@ -150,15 +159,20 @@ def run_pbt(
     exploit/explore exchange at generation boundaries is the only
     cross-trial coordination — and it is host-side metadata + one
     device_put per exploited member.
+
+    Multi-controller SPMD: every process builds only the members whose
+    submesh it owns (the same membership contract as ``run_hpo``), but
+    all processes track every member's score and lr so scheduling
+    decisions are identical everywhere. Scores are combined with one
+    ``process_allgather`` per generation; an exploit whose source and
+    target live on different processes moves the winner's host state
+    with ``broadcast_one_to_all``. The torch analog would be inter-group
+    NCCL broadcasts negotiated across communicators; here it is host
+    metadata + one collective byte-move.
     """
-    if jax.process_count() > 1:
-        raise NotImplementedError(
-            "run_pbt currently requires single-controller mode: the "
-            "exploit step fetches remote submesh states with device_get, "
-            "which cannot address devices owned by other processes. "
-            "Multi-host PBT needs a cross-process transfer "
-            "(multihost_utils.broadcast) — planned."
-        )
+    multihost = jax.process_count() > 1
+    if multihost:
+        from jax.experimental import multihost_utils
     if groups is None:
         groups = setup_groups(cfg.population)
     if len(groups) != cfg.population:
@@ -171,10 +185,32 @@ def run_pbt(
     init_lrs = np.exp(
         rng.uniform(np.log(cfg.lr_min), np.log(cfg.lr_max), cfg.population)
     )
-    members = [
-        _Member(g, i, cfg, model, train_data, eval_data, float(init_lrs[i]))
+    # Deterministic host metadata every process tracks for ALL members;
+    # device state exists only for local members.
+    lrs = [float(v) for v in init_lrs]
+    members = {
+        i: _Member(g, i, cfg, model, train_data, eval_data, lrs[i])
         for i, g in enumerate(groups)
-    ]
+        if g.is_local_member
+    }
+
+    # Broadcast buffer for processes that don't own an exploit's source
+    # member: the same construction path as the real member states
+    # (steps.build_train_state), so the trees can never drift apart.
+    template = (
+        jax.tree.map(
+            np.asarray,
+            jax.device_get(
+                build_train_state(
+                    model,
+                    optax.inject_hyperparams(optax.adam)(learning_rate=lrs[0]),
+                    jax.random.key(0),
+                )
+            ),
+        )
+        if multihost
+        else None
+    )
 
     # clamp to half the population so the top and bottom slices can never
     # overlap (an overlapping slice would let an exploiter clone a state
@@ -185,63 +221,104 @@ def run_pbt(
     t0 = time.time()
 
     for gen in range(cfg.generations):
-        # --- explore phase: one scan-fused dispatch per member puts a
-        # full generation of steps in flight on every submesh at once
-        for m in members:
+        # --- explore phase: one scan-fused dispatch per local member
+        # puts a full generation of steps in flight on every submesh
+        for m in members.values():
             m.run_generation()
 
-        scores = {m.member_id: m.eval_loss() for m in members}
-        ranked = sorted(members, key=lambda m: scores[m.member_id])
+        # --- score every member globally: local evals, then one
+        # allgather-min (non-owned slots carry +inf)
+        local_scores = np.full(cfg.population, np.inf, np.float64)
+        for i, m in members.items():
+            local_scores[i] = m.eval_loss()
+        if multihost:
+            gathered = multihost_utils.process_allgather(local_scores)
+            scores_arr = np.asarray(gathered).min(axis=0)
+        else:
+            scores_arr = local_scores
+        scores = {i: float(scores_arr[i]) for i in range(cfg.population)}
+        ranked = sorted(range(cfg.population), key=lambda i: (scores[i], i))
         record = {
             "generation": gen,
-            "scores": {m.member_id: scores[m.member_id] for m in ranked},
-            "lrs": {m.member_id: m.lr for m in members},
+            "scores": {i: scores[i] for i in ranked},
+            "lrs": {i: lrs[i] for i in range(cfg.population)},
             "exploits": [],
         }
 
         # --- exploit/explore: bottom n_exploit copy a top-n_exploit peer
         # (guard: ranked[-0:] would be the WHOLE list, so population=1 —
-        # where n_exploit clamps to 0 — must skip the exchange entirely)
+        # where n_exploit clamps to 0 — must skip the exchange entirely).
+        # Decisions derive from the global scores, so every process makes
+        # the identical choices (and draws the identical perturbations).
         top, bottom = (
             (ranked[:n_exploit], ranked[-n_exploit:]) if n_exploit else ([], [])
         )
-        for i, bad in enumerate(bottom):
-            good = top[i % len(top)]
-            if scores[bad.member_id] <= scores[good.member_id]:
+        for i, bad_id in enumerate(bottom):
+            good_id = top[i % len(top)]
+            if scores[bad_id] <= scores[good_id]:
                 continue
-            # cross-submesh weight + optimizer-state transfer: fetch the
-            # winner's replicated state, place it onto the loser's mesh
-            cloned = bad.trial.device_put(jax.device_get(good.state))
+            good_trial, bad_trial = groups[good_id], groups[bad_id]
             factor = float(rng.choice(cfg.perturb_factors))
             new_lr = float(
-                np.clip(good.lr * factor, cfg.lr_min, cfg.lr_max)
+                np.clip(lrs[good_id] * factor, cfg.lr_min, cfg.lr_max)
             )
-            bad.state = _set_lr(cloned, new_lr)
-            bad.lr = new_lr
+            # cross-submesh weight + optimizer-state transfer: the
+            # winner's replicated state moves via host memory. When the
+            # source lives on another process, one broadcast (from the
+            # owner of the source's first device) hands every process
+            # the bytes; target owners then place them on their mesh.
+            src_is_local = good_id in members
+            needed_here = src_is_local or bad_id in members
+            # Ownership sets are global device metadata, so every process
+            # computes the same answer: when everyone who needs the state
+            # already owns the source, the world-collective broadcast is
+            # pure waste — a full params+moments transfer skipped.
+            good_owners = {d.process_index for d in good_trial.devices}
+            bad_owners = {d.process_index for d in bad_trial.devices}
+            if multihost and not bad_owners <= good_owners:
+                is_source = (
+                    good_trial.devices[0].process_index == jax.process_index()
+                )
+                payload = (
+                    jax.tree.map(
+                        np.asarray, jax.device_get(members[good_id].state)
+                    )
+                    if src_is_local
+                    else template
+                )
+                host_state = multihost_utils.broadcast_one_to_all(
+                    payload, is_source=is_source
+                )
+            elif needed_here:
+                host_state = jax.device_get(members[good_id].state)
+            if bad_id in members:
+                bad = members[bad_id]
+                cloned = bad_trial.device_put(host_state)
+                bad.state = _set_lr(cloned, new_lr, trial=bad_trial)
+                bad.lr = new_lr
+            lrs[bad_id] = new_lr
             record["exploits"].append(
-                {
-                    "from": good.member_id,
-                    "to": bad.member_id,
-                    "new_lr": new_lr,
-                }
+                {"from": good_id, "to": bad_id, "new_lr": new_lr}
             )
-            if verbose:
+            if verbose and bad_id in members:
                 log0(
-                    f"PBT gen {gen}: member {bad.member_id} "
-                    f"(loss {scores[bad.member_id]:.2f}) exploits "
-                    f"{good.member_id} (loss {scores[good.member_id]:.2f}), "
+                    f"PBT gen {gen}: member {bad_id} "
+                    f"(loss {scores[bad_id]:.2f}) exploits "
+                    f"{good_id} (loss {scores[good_id]:.2f}), "
                     f"lr -> {new_lr:.2e}",
-                    trial=bad.trial,
+                    trial=bad_trial,
                 )
 
         result.history.append(record)
         best = ranked[0]
-        if scores[best.member_id] < result.best_eval_loss:
-            result.best_eval_loss = scores[best.member_id]
-            result.best_member = best.member_id
+        if scores[best] < result.best_eval_loss:
+            result.best_eval_loss = scores[best]
+            result.best_member = best
 
     result.wall_s = time.time() - t0
-    result.final_lrs = [m.lr for m in members]
+    result.final_lrs = list(lrs)
+    if out_dir and jax.process_index() != 0:
+        out_dir = None  # one writer process for the shared report
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
         with open(os.path.join(out_dir, "pbt.json"), "w") as f:

@@ -150,11 +150,39 @@ class TrialMesh:
         weight on a 2-D (data × model) submesh."""
         return NamedSharding(self.mesh, P(*spec))
 
+    @property
+    def is_writer_process(self) -> bool:
+        """Whether this process is the group's designated artifact writer
+        (the owner of the group's first device). Exactly one process per
+        group: the multi-controller guard that keeps images, checkpoints,
+        and metrics written once per trial instead of once per owner
+        process (the reference's every-rank-writes behavior is quirk Q4's
+        second half, ``vae-hpo.py:156-158``)."""
+        return self.devices[0].process_index == jax.process_index()
+
     def device_put(self, tree, sharding: Optional[NamedSharding] = None):
-        """Place a pytree onto this group's devices (replicated by default)."""
-        return jax.device_put(
-            tree, self.replicated_sharding if sharding is None else sharding
-        )
+        """Place a host pytree onto this group's devices (replicated by
+        default).
+
+        Multi-controller safe: when the submesh spans processes (or this
+        process owns none of it), placement goes through
+        ``make_array_from_callback`` so each process materializes only
+        its addressable shards — every process must call this with the
+        same values (host-side determinism), the same contract as the
+        data path (``data/sampler.py``)."""
+        sh = self.replicated_sharding if sharding is None else sharding
+        if jax.process_count() == 1:
+            return jax.device_put(tree, sh)
+
+        def put_leaf(x, leaf_sh):
+            x = np.asarray(x)
+            return jax.make_array_from_callback(
+                x.shape, leaf_sh, lambda idx: x[idx]
+            )
+
+        if isinstance(sh, NamedSharding):
+            return jax.tree.map(lambda x: put_leaf(x, sh), tree)
+        return jax.tree.map(put_leaf, tree, sh)
 
     def __repr__(self) -> str:  # keep dataclass-frozen hash/eq, short repr
         return (

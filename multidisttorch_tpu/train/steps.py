@@ -48,6 +48,28 @@ class TrainState:
     step: jnp.ndarray  # int32 scalar
 
 
+def build_train_state(
+    model: VAE, tx: optax.GradientTransformation, rng: jax.Array
+) -> TrainState:
+    """Construct an un-placed :class:`TrainState` on the default device.
+
+    The single source of the state pytree's structure: placement
+    (:func:`create_train_state`) and the multi-host broadcast template
+    (``hpo/pbt.py``) both derive from it, so the tree every process
+    expects in a cross-process transfer can never drift from the tree
+    members actually train.
+    """
+    params = model.init(
+        {"params": rng, "reparam": rng},
+        jnp.zeros((1, model.input_dim), jnp.float32),
+    )["params"]
+    return TrainState(
+        params=params,
+        opt_state=tx.init(params),
+        step=jnp.zeros((), jnp.int32),
+    )
+
+
 def create_train_state(
     trial: TrialMesh,
     model: VAE,
@@ -69,21 +91,15 @@ def create_train_state(
     (Do NOT jit the init: jit constant-folds the zeros and drops the
     sharding.)
     """
-    variables = model.init(
-        {"params": rng, "reparam": rng},
-        jnp.zeros((1, model.input_dim), jnp.float32),
-    )
-    params = variables["params"]
     if param_shardings is None:
-        state = TrainState(
-            params=params,
-            opt_state=tx.init(params),
-            step=jnp.zeros((), jnp.int32),
-        )
-        return trial.device_put(state)
+        return trial.device_put(build_train_state(model, tx, rng))
 
     from jax.sharding import NamedSharding
 
+    params = model.init(
+        {"params": rng, "reparam": rng},
+        jnp.zeros((1, model.input_dim), jnp.float32),
+    )["params"]
     params = jax.device_put(params, param_shardings)
     # Eager init: computation-follows-data gives each Adam moment its
     # weight's sharding (a jit'd init would constant-fold the zeros and

@@ -1,0 +1,118 @@
+"""Worker program for the multi-controller integration tests.
+
+Launched as 2 cooperating processes by ``test_multihost.py`` (4 virtual
+CPU devices each → an 8-device, 2-process world). Bring-up goes through
+the framework's own launcher-env path: the parent sets
+``OMPI_COMM_WORLD_SIZE/RANK`` + ``MASTER_ADDR/PORT`` (the reference's
+Summit-style environment, ``/root/reference/utils.py:13-16,108-109``)
+and ``initialize_runtime`` does the rest.
+
+Each mode prints one ``RESULT {json}`` line the parent asserts on.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    mode, out_dir = sys.argv[1], sys.argv[2]
+
+    import multidisttorch_tpu as mdt
+    from multidisttorch_tpu.data.datasets import synthetic_mnist
+
+    nproc, pid = mdt.initialize_runtime()
+    assert nproc == 2, f"expected 2 processes, got {nproc}"
+    assert len(jax.devices()) == 8, jax.devices()
+
+    train = synthetic_mnist(128, seed=0)
+    test = synthetic_mnist(32, seed=1)
+
+    if mode == "hpo_split":
+        # Two groups of 4 devices: group g is wholly owned by process g.
+        # Each process must run exactly its own trial.
+        from multidisttorch_tpu.hpo.driver import TrialConfig, run_hpo
+
+        configs = [
+            TrialConfig(g, epochs=1, batch_size=16, hidden_dim=16,
+                        latent_dim=4, lr=1e-3 * (g + 1), seed=g)
+            for g in range(2)
+        ]
+        results = run_hpo(
+            configs, train, test, out_dir=out_dir, num_groups=2,
+            verbose=False, save_images=False, save_checkpoints=False,
+        )
+        summary = {
+            "pid": pid,
+            "local_trials": [r.trial_id for r in results],
+            "losses": {r.trial_id: round(r.final_train_loss, 4) for r in results},
+            "steps": {r.trial_id: r.steps for r in results},
+        }
+
+    elif mode == "hpo_span":
+        # ONE group spanning all 8 devices across both processes: the
+        # multi-host data path (make_array_from_callback feeding) and
+        # writer-process gating under real SPMD.
+        from multidisttorch_tpu.hpo.driver import TrialConfig, run_hpo
+
+        cfg = TrialConfig(0, epochs=2, batch_size=16, hidden_dim=16,
+                          latent_dim=4, fused_steps=3)
+        results = run_hpo(
+            [cfg], train, test, out_dir=out_dir, num_groups=1,
+            verbose=False, save_images=False, save_checkpoints=True,
+        )
+        r = results[0]
+        summary = {
+            "pid": pid,
+            "final_train_loss": round(r.final_train_loss, 4),
+            "final_test_loss": round(r.final_test_loss, 4),
+            "steps": r.steps,
+            "wrote_metrics": os.path.exists(
+                os.path.join(out_dir, "trial-0", "metrics.json")
+            ),
+            "wrote_ckpt": bool(r.checkpoint),
+        }
+
+    elif mode == "pbt":
+        # Population of 2, one member per process; cross-process exploit
+        # moves weights via broadcast_one_to_all. Both processes must
+        # report identical global decisions.
+        from multidisttorch_tpu.hpo.pbt import PBTConfig, run_pbt
+
+        cfg = PBTConfig(
+            population=2, generations=2, steps_per_generation=4,
+            batch_size=16, hidden_dim=16, latent_dim=4,
+            exploit_fraction=0.5, lr_min=1e-4, lr_max=1e-1, seed=0,
+        )
+        result = run_pbt(cfg, train, test, out_dir=out_dir, verbose=False)
+        summary = {
+            "pid": pid,
+            "best_member": result.best_member,
+            "best_eval_loss": round(result.best_eval_loss, 4),
+            "final_lrs": [round(v, 8) for v in result.final_lrs],
+            "n_exploits": sum(len(g["exploits"]) for g in result.history),
+            "scores": [
+                {k: round(v, 4) for k, v in g["scores"].items()}
+                for g in result.history
+            ],
+        }
+
+    else:
+        raise SystemExit(f"unknown mode {mode}")
+
+    print("RESULT " + json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()

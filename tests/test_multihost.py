@@ -1,0 +1,125 @@
+"""True multi-controller integration tests: 2 cooperating processes,
+4 virtual CPU devices each (8-device world over the Gloo-backed JAX
+distributed runtime).
+
+The reference could only validate multi-node behavior by running on the
+real clusters its env detection targets (SURVEY.md §4); these tests
+exercise the same contracts — per-process trial membership, a submesh
+spanning processes, cross-process PBT weight exchange — in plain pytest.
+
+Subprocesses are required (jax.distributed is per-process global state),
+so these tests bypass the in-process 8-fake-device conftest harness.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "mh_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _launch(mode: str, tmp_path) -> list[dict]:
+    """Run the worker twice (ranks 0/1) through the framework's own
+    OpenMPI-style env detection; return both RESULT payloads."""
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU plugin in workers
+        env.update(
+            OMPI_COMM_WORLD_SIZE="2",
+            OMPI_COMM_WORLD_RANK=str(rank),
+            MASTER_ADDR="127.0.0.1",
+            MASTER_PORT=str(port),
+        )
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, _WORKER, mode, str(tmp_path / "out")],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    # Drain both pipes concurrently: one rank dying mid-collective can
+    # fill its pipe while its peer blocks in the collective — sequential
+    # communicate() would deadlock the pair. Kill whatever survives a
+    # timeout so a hung rendezvous can't poison later tests.
+    outs: list = [None, None]
+
+    def drain(i, p):
+        try:
+            outs[i] = p.communicate(timeout=420)[0]
+        except subprocess.TimeoutExpired:
+            pass
+
+    try:
+        threads = [
+            threading.Thread(target=drain, args=(i, p))
+            for i, p in enumerate(procs)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=450)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert out is not None, f"rank {rank} timed out"
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-4000:]}"
+    results = []
+    for out in outs:
+        lines = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert lines, f"no RESULT line in:\n{out[-4000:]}"
+        results.append(json.loads(lines[-1][len("RESULT "):]))
+    return sorted(results, key=lambda r: r["pid"])
+
+
+@pytest.mark.multihost
+def test_split_groups_each_process_runs_its_trial(tmp_path):
+    r0, r1 = _launch("hpo_split", tmp_path)
+    # Process g owns group g only -> runs only trial g (the reference's
+    # membership contract, vae-hpo.py:200-202, without any collective).
+    assert r0["local_trials"] == [0]
+    assert r1["local_trials"] == [1]
+    assert r0["steps"]["0"] == 8 and r1["steps"]["1"] == 8
+
+
+@pytest.mark.multihost
+def test_spanning_group_trains_identically_on_both_processes(tmp_path):
+    r0, r1 = _launch("hpo_span", tmp_path)
+    # SPMD: both processes executed the same trial over the shared
+    # 8-device submesh and must agree bit-for-bit on the results.
+    assert r0["final_train_loss"] == r1["final_train_loss"]
+    assert r0["final_test_loss"] == r1["final_test_loss"]
+    assert r0["steps"] == r1["steps"] == 16
+    # Writer gating: artifacts exist, and only rank 0 (owner of the
+    # group's first device) reports having written the checkpoint.
+    assert r0["wrote_metrics"] and r1["wrote_metrics"]  # shared FS view
+    assert r0["wrote_ckpt"] and not r1["wrote_ckpt"]
+
+
+@pytest.mark.multihost
+def test_pbt_cross_process_exploit_agrees(tmp_path):
+    r0, r1 = _launch("pbt", tmp_path)
+    # Global decisions (scores, ranking, exploit targets, perturbed lrs)
+    # must be identical on every process; at least one exploit crossed
+    # the process boundary via broadcast_one_to_all.
+    assert r0["best_member"] == r1["best_member"]
+    assert r0["best_eval_loss"] == r1["best_eval_loss"]
+    assert r0["final_lrs"] == r1["final_lrs"]
+    assert r0["scores"] == r1["scores"]
+    assert r0["n_exploits"] == r1["n_exploits"] >= 1
