@@ -165,6 +165,48 @@ def find_ifname(address: str) -> Optional[str]:
     return None
 
 
+def select_platform(
+    environ: Optional[dict] = None, default: Optional[str] = None
+) -> Optional[str]:
+    """Honor the ``MDT_PLATFORM`` backend override; returns it (or None).
+
+    The operator's escape hatch, mirroring the reference's
+    ``DDP_BACKEND`` env override (``/root/reference/utils.py:96-97``)
+    which forces a torch backend ahead of autodetection. Here the
+    analogous knob forces the JAX platform (``cpu``/``tpu``/a plugin
+    name) *before* backend initialization — e.g. ``MDT_PLATFORM=cpu``
+    keeps a job off a wedged TPU plugin entirely. An empty/unset var
+    means "no override" (falls back to ``default``, usually None).
+
+    Must be called before anything touches a JAX backend: raises an
+    honest error — *without* mutating global config — if the backend
+    already initialized to a different platform (``jax.config.update``
+    silently ignores late changes, so pretending would mask the no-op).
+    """
+    env = os.environ if environ is None else environ
+    platform = env.get("MDT_PLATFORM") or default
+    if not platform:
+        return None
+    import jax
+
+    try:
+        from jax._src import xla_bridge
+
+        already_initialized = bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        already_initialized = False
+    if already_initialized:
+        if jax.default_backend() != platform.split(",")[0]:
+            raise RuntimeError(
+                f"MDT_PLATFORM={platform!r} requested but the JAX backend "
+                f"already initialized as {jax.default_backend()!r}; set "
+                "the override before first device use"
+            )
+        return platform  # already effective; nothing to change
+    jax.config.update("jax_platforms", platform)
+    return platform
+
+
 _initialized_env: Optional[ProcessEnv] = None
 
 
@@ -195,6 +237,7 @@ def initialize_runtime(
     if _initialized_env is not None:
         return _initialized_env.num_processes, _initialized_env.process_id
 
+    select_platform(environ)
     penv = detect_process_env(environ)
     if penv.num_processes > 1:
         import jax

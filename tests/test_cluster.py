@@ -9,6 +9,7 @@ from multidisttorch_tpu.parallel.cluster import (
     find_ifname,
     parse_slurm_nodelist,
     process_world,
+    select_platform,
 )
 
 
@@ -126,3 +127,35 @@ def test_find_ifname_unknown_returns_none():
 
 def test_process_world_single_controller():
     assert process_world() == (1, 0)
+
+
+class TestSelectPlatform:
+    """MDT_PLATFORM — the DDP_BACKEND-style backend override
+    (reference: /root/reference/utils.py:96-97)."""
+
+    def test_unset_is_none_and_touches_nothing(self):
+        assert select_platform({}) is None
+        assert select_platform({"MDT_PLATFORM": ""}) is None
+
+    def test_matching_platform_accepted_after_init(self):
+        # The test harness already initialized the cpu backend; forcing
+        # the same platform must succeed and report it.
+        assert select_platform({"MDT_PLATFORM": "cpu"}) == "cpu"
+
+    def test_mismatched_platform_after_init_raises(self):
+        # Silent no-ops are the failure mode this knob exists to avoid:
+        # jax.config.update ignores late changes, so the framework must
+        # detect them and fail loudly — without mutating global config
+        # on the error path.
+        import jax
+
+        jax.devices()  # order-independence: force backend init
+        with pytest.raises(RuntimeError, match="already initialized"):
+            select_platform({"MDT_PLATFORM": "tpu"})
+        assert jax.default_backend() == "cpu"
+        # config untouched: re-selecting the real platform still succeeds
+        assert select_platform({"MDT_PLATFORM": "cpu"}) == "cpu"
+
+    def test_default_argument(self):
+        assert select_platform({}, default="cpu") == "cpu"
+        assert select_platform({"MDT_PLATFORM": ""}, default="cpu") == "cpu"
