@@ -15,6 +15,7 @@ vs_baseline = our throughput / reference-loop throughput.
 """
 
 import json
+import subprocess
 import sys
 import time
 import warnings
@@ -41,6 +42,136 @@ HIDDEN, LATENT = 400, 20
 CHUNK_STEPS = 100  # inner lax.scan steps per dispatch (make_multi_step)
 MEASURE_CHUNKS = 10
 TORCH_MEASURE_STEPS = 30
+
+PREFLIGHT_TIMEOUT_S = 150  # first TPU init is ~20-40s healthy; a wedged
+# plugin blocks forever (round 1: rc=124 after 9 min) — cap it here.
+
+
+def _preflight_default_backend() -> dict:
+    """Probe the default JAX backend in a subprocess with a timeout.
+
+    Round-1 failure mode: ``jax.devices()`` on a wedged TPU plugin either
+    crashes with UNAVAILABLE or blocks until the driver's timeout kills
+    the whole bench, recording nothing. Probing out-of-process turns both
+    into a fast, attributable diagnostic; the parent process never
+    touches the broken backend and can still record a CPU-fallback
+    number. Returns {"ok", "platform", "device_kind", "n_devices"} or
+    {"ok": False, "error": ...} with the probe's stderr tail.
+    """
+    code = (
+        "import jax\n"
+        "d = jax.devices()\n"
+        "print('PROBE|%s|%s|%d' % (d[0].platform, d[0].device_kind, len(d)))\n"
+    )
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=PREFLIGHT_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired as e:
+        tail = ((e.stderr or b"").decode(errors="replace") if isinstance(e.stderr, bytes) else (e.stderr or ""))[-400:]
+        return {
+            "ok": False,
+            "error": (
+                f"backend init still blocked after {PREFLIGHT_TIMEOUT_S}s "
+                "(wedged plugin or unreachable chip — check for a leaked "
+                "process holding the TPU)"
+            ),
+            "stderr_tail": tail,
+        }
+    for line in p.stdout.splitlines():
+        if line.startswith("PROBE|"):
+            _, platform, kind, n = line.split("|")
+            return {
+                "ok": True,
+                "platform": platform,
+                "device_kind": kind,
+                "n_devices": int(n),
+            }
+    return {
+        "ok": False,
+        "error": f"backend init failed (rc={p.returncode})",
+        "stderr_tail": p.stderr[-400:],
+    }
+
+
+def _ensure_backend() -> dict:
+    """Pick the bench platform; never hang or crash on a wedged TPU.
+
+    Priority: MDT_PLATFORM override (see parallel/cluster.py) →
+    JAX_PLATFORMS=cpu test harness → preflight-verified default backend →
+    CPU fallback carrying the TPU diagnostic. Returns provenance for the
+    emitted JSON: {"platform", "device_kind", "tpu_error"?}.
+    """
+    from multidisttorch_tpu.parallel.cluster import select_platform
+
+    forced = select_platform()
+    if forced:
+        d = jax.devices()[0]
+        return {"platform": d.platform, "device_kind": d.device_kind,
+                "forced_by": "MDT_PLATFORM"}
+    if os.environ.get("JAX_PLATFORMS", "").split(",")[0] == "cpu":
+        d = jax.devices()[0]
+        return {"platform": d.platform, "device_kind": d.device_kind}
+    probe = _preflight_default_backend()
+    if probe["ok"]:
+        return {
+            "platform": probe["platform"],
+            "device_kind": probe["device_kind"],
+        }
+    jax.config.update("jax_platforms", "cpu")
+    d = jax.devices()[0]
+    return {
+        "platform": d.platform,
+        "device_kind": d.device_kind,
+        "tpu_error": probe["error"],
+        "tpu_stderr_tail": probe.get("stderr_tail", ""),
+    }
+
+
+def _train_flops_per_sample() -> float:
+    """Analytic matmul FLOPs for one optimizer step, per sample.
+
+    Forward = 2·MACs over the five dense layers of the flagship VAE
+    (784-400-(20,20)-400-784); backward for a dense stack is ~2x forward
+    (grad-activations + grad-weights matmuls), so train ≈ 3x forward.
+    Elementwise/optimizer FLOPs are negligible next to the matmuls.
+    """
+    dims = [
+        (784, HIDDEN),
+        (HIDDEN, LATENT),
+        (HIDDEN, LATENT),
+        (LATENT, HIDDEN),
+        (HIDDEN, 784),
+    ]
+    fwd = 2.0 * sum(a * b for a, b in dims)
+    return 3.0 * fwd
+
+
+# Peak dense bf16 FLOP/s per chip by device generation (public numbers).
+_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v5": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def _peak_flops_per_chip(device_kind: str) -> float | None:
+    kind = (device_kind or "").lower()
+    for key in sorted(_PEAK_FLOPS, key=len, reverse=True):
+        if key in kind:
+            return _PEAK_FLOPS[key]
+    # Only when the device kind itself is unrecognized, fall back to the
+    # environment's generation hint (a stale hint must not override a
+    # real detection).
+    hint = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    return _PEAK_FLOPS.get(hint)
 
 
 def _flagship_setup(num_groups: int = 1):
@@ -189,13 +320,35 @@ def bench_concurrency(num_trials: int) -> dict:
     # each trial did MEASURE_CHUNKS * CHUNK_STEPS steps
     per_trial_sps = MEASURE_CHUNKS * CHUNK_STEPS * BATCH / dt
 
-    return {
+    ndev = len(jax.devices())
+    out = {
         "num_trials": num_trials,
         "alone_samples_per_sec": round(alone_sps, 1),
         "concurrent_per_trial_samples_per_sec": round(per_trial_sps, 1),
         "aggregate_samples_per_sec": round(per_trial_sps * num_trials, 1),
         "efficiency_vs_alone": round(per_trial_sps / alone_sps, 3),
+        "n_devices": ndev,
+        # The north-star config is 8 trials x >=1 chip each (BASELINE.md,
+        # >=0.90 efficiency). Say in the artifact itself when this
+        # environment cannot measure that for real (VERDICT r1 weak #8):
+        # fewer devices than trials = time-slicing one chip; virtual CPU
+        # devices = every "device" shares the same host cores, so
+        # efficiency_vs_alone is a methodology proof, not a hardware
+        # number.
+        "hardware_limited": ndev < num_trials
+        or jax.default_backend() == "cpu",
     }
+    if jax.default_backend() == "cpu":
+        out["methodology_note"] = (
+            "virtual CPU devices share one host's cores; "
+            "efficiency_vs_alone is not hardware-representative"
+        )
+    elif ndev < num_trials:
+        out["methodology_note"] = (
+            f"{num_trials} trials time-sliced over {ndev} real device(s); "
+            "north-star needs >=1 chip per trial"
+        )
+    return out
 
 
 def bench_to_elbo(target: float, max_steps: int = 20000) -> dict:
@@ -262,8 +415,12 @@ def main():
 
     if args.concurrency is not None and args.to_elbo is not None:
         parser.error("--concurrency and --to-elbo are mutually exclusive")
+
+    backend = _ensure_backend()
+
     if args.to_elbo is not None:
         r = bench_to_elbo(args.to_elbo)
+        r.update(backend)
         print(
             json.dumps(
                 {
@@ -281,6 +438,7 @@ def main():
         parser.error(f"--concurrency must be >= 1, got {args.concurrency}")
     if args.concurrency is not None:
         r = bench_concurrency(args.concurrency)
+        r.update(backend)
         print(
             json.dumps(
                 {
@@ -301,6 +459,19 @@ def main():
         print(f"reference torch bench failed: {e!r}", file=sys.stderr)
         ref = float("nan")
     vs = ours / ref if ref == ref and ref > 0 else float("nan")
+    # MFU: hardware-meaningful single-chip framing (VERDICT r1 weak #3) —
+    # fraction of the chip's peak dense bf16 FLOP/s the train loop
+    # sustains. None off-TPU or on unknown device kinds.
+    peak = (
+        _peak_flops_per_chip(backend.get("device_kind", ""))
+        if backend.get("platform") not in (None, "cpu")
+        else None
+    )
+    mfu = (ours * _train_flops_per_sample() / peak) if peak else None
+    detail = dict(backend)
+    if peak:
+        detail["peak_flops_per_chip"] = peak
+        detail["train_flops_per_sample"] = _train_flops_per_sample()
     print(
         json.dumps(
             {
@@ -308,6 +479,8 @@ def main():
                 "value": round(ours, 1),
                 "unit": "samples/sec/chip",
                 "vs_baseline": round(vs, 3) if vs == vs else None,
+                "mfu": round(mfu, 5) if mfu is not None else None,
+                "detail": detail,
             }
         )
     )

@@ -192,8 +192,20 @@ def select_platform(
     try:
         from jax._src import xla_bridge
 
-        already_initialized = bool(getattr(xla_bridge, "_backends", None))
+        already_initialized = bool(xla_bridge._backends)
     except Exception:
+        # Private probe gone (jax upgrade): we can no longer tell whether
+        # a late override would silently no-op. Say so instead of
+        # guessing — the whole point of this knob is no silent no-ops.
+        import warnings
+
+        warnings.warn(
+            "cannot verify JAX backend-init state (jax internals moved); "
+            f"MDT_PLATFORM={platform!r} may silently not take effect if "
+            "a backend was already initialized",
+            RuntimeWarning,
+            stacklevel=2,
+        )
         already_initialized = False
     if already_initialized:
         if jax.default_backend() != platform.split(",")[0]:
