@@ -5,4 +5,4 @@ from multidisttorch_tpu.data.datasets import (
     synthetic_cifar10,
     synthetic_mnist,
 )
-from multidisttorch_tpu.data.sampler import TrialDataIterator
+from multidisttorch_tpu.data.sampler import EvalDataIterator, TrialDataIterator

@@ -176,8 +176,6 @@ class TrialDataIterator:
         Yields ``(start_batch_index, imgs[, labels])``; a trailing
         partial chunk is yielded only with ``flush_tail``.
         """
-        if k < 1:
-            raise ValueError(f"chunk size must be >= 1, got {k}")
         from multidisttorch_tpu.parallel.mesh import DATA_AXIS
 
         chunk_sh = self.trial.sharding(None, DATA_AXIS)
@@ -213,7 +211,8 @@ class TrialDataIterator:
         ``(start_batch_index, chunk)`` (or ``(start, imgs, labels)``
         with labels); the final chunk may hold fewer than ``k`` batches.
         """
-        yield from self._chunked(self._host_batches(epoch), k, flush_tail=True)
+        self._check_chunk_size(k)
+        return self._chunked(self._host_batches(epoch), k, flush_tail=True)
 
     def stream_chunks(self, k: int, start_epoch: int = 0) -> Iterator:
         """Endless stacked ``(k, batch, ...)`` chunks crossing epoch
@@ -226,6 +225,7 @@ class TrialDataIterator:
         dispatch compiles exactly once. Unlike :meth:`epoch_chunks`, no
         batch-index bookkeeping: yields ``imgs`` (or ``(imgs, labels)``).
         """
+        self._check_chunk_size(k)
 
         def endless():
             epoch = start_epoch
@@ -233,9 +233,101 @@ class TrialDataIterator:
                 yield from self._host_batches(epoch)
                 epoch += 1
 
-        for item in self._chunked(endless(), k, flush_tail=False):
-            yield item[1] if not self.with_labels else item[1:]
+        def strip_index():
+            for item in self._chunked(endless(), k, flush_tail=False):
+                yield item[1] if not self.with_labels else item[1:]
+
+        return strip_index()
+
+    @staticmethod
+    def _check_chunk_size(k: int) -> None:
+        # Eager: a bad k must fail at the call site, not deferred to the
+        # first next() of the generator (where the traceback no longer
+        # points at the caller's mistake).
+        if k < 1:
+            raise ValueError(f"chunk size must be >= 1, got {k}")
 
     @property
     def samples_per_epoch(self) -> int:
         return self.num_batches * self.batch_size
+
+
+class EvalDataIterator:
+    """Full-coverage eval feed: every test row, in order, pad-and-mask.
+
+    The reference's ``test`` consumes the entire test set including the
+    partial final batch (``/root/reference/vae-hpo.py:101-105``); XLA's
+    static-shape requirement forbids a smaller final batch, so instead
+    the final batch is zero-padded to ``batch_size`` and paired with a
+    0/1 weight vector. Feeding a ``masked=True``
+    ``train.steps.make_eval_step`` with these pairs yields a loss sum
+    over exactly ``len(dataset)`` rows — including test sets smaller
+    than one batch, which the train-path :class:`TrialDataIterator`
+    (correctly, for training) rejects.
+
+    No shuffling: eval order is the dataset's (the reference's eval
+    loader order), and coverage — not order — is the contract.
+    """
+
+    def __init__(
+        self,
+        dataset: Dataset,
+        trial: TrialMesh,
+        batch_size: int,
+        *,
+        with_labels: bool = False,
+    ):
+        if batch_size % trial.data_size != 0:
+            raise ValueError(
+                f"batch_size {batch_size} must divide evenly over the "
+                f"trial's data axis of {trial.data_size} devices "
+                "(static per-device shapes)"
+            )
+        if len(dataset) == 0:
+            raise ValueError("cannot evaluate an empty dataset")
+        self.dataset = dataset
+        self.trial = trial
+        self.batch_size = batch_size
+        self.with_labels = with_labels
+        self.num_rows = len(dataset)
+        self.num_batches = -(-self.num_rows // batch_size)  # ceil
+
+    def _put(self, rows: np.ndarray):
+        sh = self.trial.batch_sharding
+        if jax.process_count() == 1:
+            return jax.device_put(rows, sh)
+        return jax.make_array_from_callback(
+            rows.shape, sh, lambda idx: rows[idx]
+        )
+
+    def _pad(self, arr: np.ndarray) -> np.ndarray:
+        short = self.batch_size - arr.shape[0]
+        if short == 0:
+            return arr
+        pad_width = [(0, short)] + [(0, 0)] * (arr.ndim - 1)
+        return np.pad(arr, pad_width)
+
+    def batches(self) -> Iterator:
+        """Yield ``(imgs, weights)`` (or ``(imgs, labels, weights)``)
+        device-ready tuples; weights are 1.0 on real rows, 0.0 on the
+        final batch's padding."""
+        bs = self.batch_size
+        for b in range(self.num_batches):
+            rows = self.dataset.images[b * bs : (b + 1) * bs]
+            n_real = rows.shape[0]
+            weights = np.zeros(bs, np.float32)
+            weights[:n_real] = 1.0
+            imgs = self._put(self._pad(rows))
+            if self.with_labels:
+                labels = self._pad(
+                    self.dataset.labels[b * bs : (b + 1) * bs]
+                )
+                yield imgs, self._put(labels), self._put(weights)
+            else:
+                yield imgs, self._put(weights)
+
+    def first_host_batch(self) -> np.ndarray:
+        """The first eval batch's real rows, host-side (for the
+        reconstruction comparison grid — same data on every process, no
+        collective)."""
+        return self.dataset.images[: self.batch_size]

@@ -49,7 +49,7 @@ import numpy as np
 import optax
 
 from multidisttorch_tpu.data.datasets import Dataset
-from multidisttorch_tpu.data.sampler import TrialDataIterator
+from multidisttorch_tpu.data.sampler import EvalDataIterator, TrialDataIterator
 from multidisttorch_tpu.models.vae import VAE
 from multidisttorch_tpu.parallel.mesh import TrialMesh, setup_groups
 from multidisttorch_tpu.train.checkpoint import restore_state, save_state
@@ -176,7 +176,7 @@ class _TrialRun:
         # save_images argument, NOT the per-process writer-gated flag:
         # all owner processes must compile the identical eval program.
         self.eval_step = make_eval_step(
-            trial, model, beta=cfg.beta, with_recon=save_images
+            trial, model, beta=cfg.beta, with_recon=save_images, masked=True
         )
         self.sample_step = make_sample_step(trial, model)
         self.train_iter = TrialDataIterator(
@@ -187,9 +187,12 @@ class _TrialRun:
             shard_across_trials=shard_across_trials,
             num_trials=num_trials,
         )
+        # Full-coverage eval (reference parity, vae-hpo.py:101-105): the
+        # pad-and-mask iterator consumes every test row — including test
+        # sets smaller than one batch, which round 1 silently skipped.
         self.test_iter = (
-            TrialDataIterator(test_data, trial, cfg.batch_size, seed=cfg.seed)
-            if test_data is not None and len(test_data) >= cfg.batch_size
+            EvalDataIterator(test_data, trial, cfg.batch_size)
+            if test_data is not None and len(test_data) > 0
             else None
         )
         self._first_test_batch = None
@@ -388,26 +391,35 @@ class _TrialRun:
             epoch_record = {"epoch": epoch, "avg_train_loss": avg}
 
             if self.test_iter is not None:
-                test_sum, test_n, first_batch, first_recon = 0.0, 0, None, None
-                for j, tbatch in enumerate(self.test_iter.epoch(0)):
-                    out = self.eval_step(self.state, tbatch)
-                    test_sum += float(out["loss_sum"])
-                    test_n += tbatch.shape[0]
+                # On-device loss accumulation: the per-batch adds are
+                # async dispatches; the single float() at the end is the
+                # epoch's only eval host sync (round 1 synced every
+                # batch, the last per-batch round-trip on the hot path).
+                test_sum_dev, first_batch, first_recon = None, None, None
+                for j, (tbatch, tweights) in enumerate(
+                    self.test_iter.batches()
+                ):
+                    out = self.eval_step(self.state, tbatch, tweights)
+                    test_sum_dev = (
+                        out["loss_sum"]
+                        if test_sum_dev is None
+                        else test_sum_dev + out["loss_sum"]
+                    )
                     if j == 0 and self._save_images:
-                        # batch values from the deterministic host stream
+                        # batch values from the deterministic host view
                         # (the device batch is data-sharded and, on a
                         # process-spanning submesh, not fetchable whole);
                         # recon is replicated, hence fetchable anywhere.
-                        # The eval stream is always epoch 0, so the host
-                        # copy is constant — fetch it once.
                         if self._first_test_batch is None:
                             self._first_test_batch = (
-                                self.test_iter.first_host_batch(0)
+                                self.test_iter.first_host_batch()
                             )
                         first_batch = self._first_test_batch
                         first_recon = np.asarray(out["recon"])
                     yield
-                test_avg = test_sum / test_n
+                # Exact-count divisor: every real row was evaluated, the
+                # padded rows carried weight 0.0.
+                test_avg = float(test_sum_dev) / self.test_iter.num_rows
                 self._log("====> Test set loss: {:.4f}".format(test_avg))
                 epoch_record["test_loss"] = test_avg
                 self.result.final_test_loss = test_avg

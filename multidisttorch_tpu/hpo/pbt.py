@@ -29,7 +29,7 @@ import numpy as np
 import optax
 
 from multidisttorch_tpu.data.datasets import Dataset
-from multidisttorch_tpu.data.sampler import TrialDataIterator
+from multidisttorch_tpu.data.sampler import EvalDataIterator, TrialDataIterator
 from multidisttorch_tpu.models.vae import VAE
 from multidisttorch_tpu.parallel.mesh import TrialMesh, setup_groups
 from multidisttorch_tpu.train.steps import (
@@ -106,21 +106,16 @@ class _Member:
         # phase costs a single host round-trip.
         self.multi_step = make_multi_step(trial, model, tx, beta=cfg.beta)
         self.eval_step = make_eval_step(
-            trial, model, beta=cfg.beta, with_recon=False
+            trial, model, beta=cfg.beta, with_recon=False, masked=True
         )
         self.train_iter = TrialDataIterator(
             train_data, trial, cfg.batch_size, seed=cfg.seed + member_id
         )
         self._chunks = self.train_iter.stream_chunks(cfg.steps_per_generation)
-        # eval batch must keep the per-device divisibility invariant
-        eval_bs = min(cfg.batch_size, len(eval_data))
-        eval_bs -= eval_bs % trial.data_size
-        if eval_bs == 0:
-            raise ValueError(
-                f"eval set of {len(eval_data)} rows too small for a "
-                f"{trial.data_size}-wide data axis"
-            )
-        self.eval_iter = TrialDataIterator(eval_data, trial, eval_bs, seed=0)
+        # Pad-and-mask eval: every eval row scores, regardless of how the
+        # eval set divides the batch (same full-coverage contract as the
+        # HPO driver's test loop).
+        self.eval_iter = EvalDataIterator(eval_data, trial, cfg.batch_size)
         self._key = jax.random.key(1000 + member_id)
         self._step = 0
 
@@ -134,12 +129,14 @@ class _Member:
         return m
 
     def eval_loss(self) -> float:
-        total, n = 0.0, 0
-        for batch in self.eval_iter.epoch(0):
-            out = self.eval_step(self.state, batch)
-            total += float(out["loss_sum"])
-            n += batch.shape[0]
-        return total / n
+        # Device-side accumulation; one host sync at the end.
+        total = None
+        for batch, weights in self.eval_iter.batches():
+            out = self.eval_step(self.state, batch, weights)
+            total = (
+                out["loss_sum"] if total is None else total + out["loss_sum"]
+            )
+        return float(total) / self.eval_iter.num_rows
 
 
 def run_pbt(
@@ -267,8 +264,6 @@ def run_pbt(
             # source lives on another process, one broadcast (from the
             # owner of the source's first device) hands every process
             # the bytes; target owners then place them on their mesh.
-            src_is_local = good_id in members
-            needed_here = src_is_local or bad_id in members
             # Ownership sets are global device metadata, so every process
             # computes the same answer: when everyone who needs the state
             # already owns the source, the world-collective broadcast is
@@ -279,17 +274,24 @@ def run_pbt(
                 is_source = (
                     good_trial.devices[0].process_index == jax.process_index()
                 )
+                # Only the is_source process's bytes are consumed by the
+                # broadcast; every other process passes the shape-only
+                # template rather than paying a full params+moments
+                # device_get whose result would be discarded.
                 payload = (
                     jax.tree.map(
                         np.asarray, jax.device_get(members[good_id].state)
                     )
-                    if src_is_local
+                    if is_source
                     else template
                 )
                 host_state = multihost_utils.broadcast_one_to_all(
                     payload, is_source=is_source
                 )
-            elif needed_here:
+            elif bad_id in members:
+                # Non-broadcast path: fetch only where the state is about
+                # to be consumed (the target's owners; they also own the
+                # source here, or we'd be in the broadcast branch).
                 host_state = jax.device_get(members[good_id].state)
             if bad_id in members:
                 bad = members[bad_id]

@@ -19,21 +19,42 @@ import jax
 import jax.numpy as jnp
 
 
+def bernoulli_recon_per_sample(
+    recon_logits: jnp.ndarray, x: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-sample binary cross-entropy from logits, shape ``(n,)``.
+
+    Computed stably as ``max(l,0) - l*x + log1p(exp(-|l|))`` summed over
+    each sample's features — the single source of the BCE expression for
+    both the summed and weighted variants below.
+    """
+    l = recon_logits
+    per_elem = jnp.maximum(l, 0.0) - l * x + jnp.log1p(jnp.exp(-jnp.abs(l)))
+    return jnp.sum(per_elem.reshape(per_elem.shape[0], -1), axis=1)
+
+
+def gaussian_kl_per_sample(
+    mu: jnp.ndarray, logvar: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-sample ``-0.5 * sum(1 + logvar - mu^2 - exp(logvar))``, shape
+    ``(n,)``."""
+    return -0.5 * jnp.sum(
+        1.0 + logvar - jnp.square(mu) - jnp.exp(logvar), axis=1
+    )
+
+
 def bernoulli_recon_sum(recon_logits: jnp.ndarray, x: jnp.ndarray) -> jnp.ndarray:
     """Summed binary cross-entropy from logits.
 
     Equals ``F.binary_cross_entropy(sigmoid(logits), x, reduction="sum")``
-    (``vae-hpo.py:50``) up to float rounding, computed stably as
-    ``max(l,0) - l*x + log1p(exp(-|l|))`` summed over all elements.
+    (``vae-hpo.py:50``) up to float rounding.
     """
-    l = recon_logits
-    per_elem = jnp.maximum(l, 0.0) - l * x + jnp.log1p(jnp.exp(-jnp.abs(l)))
-    return jnp.sum(per_elem)
+    return jnp.sum(bernoulli_recon_per_sample(recon_logits, x))
 
 
 def gaussian_kl_sum(mu: jnp.ndarray, logvar: jnp.ndarray) -> jnp.ndarray:
     """``-0.5 * sum(1 + logvar - mu^2 - exp(logvar))`` (``vae-hpo.py:56``)."""
-    return -0.5 * jnp.sum(1.0 + logvar - jnp.square(mu) - jnp.exp(logvar))
+    return jnp.sum(gaussian_kl_per_sample(mu, logvar))
 
 
 def elbo_loss_sum(
@@ -51,6 +72,30 @@ def elbo_loss_sum(
     the batch size at the logging sites (``vae-hpo.py:83,89,118``).
     """
     return bernoulli_recon_sum(recon_logits, x) + beta * gaussian_kl_sum(mu, logvar)
+
+
+def elbo_loss_weighted_sum(
+    recon_logits: jnp.ndarray,
+    x: jnp.ndarray,
+    mu: jnp.ndarray,
+    logvar: jnp.ndarray,
+    weights: jnp.ndarray,
+    beta: float = 1.0,
+) -> jnp.ndarray:
+    """Per-sample negative ELBO dotted with a weight vector.
+
+    ``weights`` is 1.0 for real rows and 0.0 for padding, so a padded
+    final batch contributes exactly the real rows' loss — this is how
+    eval consumes *every* test row under XLA's static-shape requirement
+    (the reference's ``test`` iterates the full test set including the
+    partial final batch, ``vae-hpo.py:101-105``; dropping the tail would
+    make reported test losses non-comparable). ``weights=ones`` reduces
+    to :func:`elbo_loss_sum` exactly.
+    """
+    per_sample = bernoulli_recon_per_sample(
+        recon_logits, x
+    ) + beta * gaussian_kl_per_sample(mu, logvar)
+    return jnp.dot(per_sample, weights)
 
 
 def softmax_cross_entropy_mean(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:

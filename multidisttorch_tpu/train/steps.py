@@ -281,8 +281,13 @@ def make_multi_step(
 
 
 def make_eval_step(
-    trial: TrialMesh, model: VAE, *, beta: float = 1.0, with_recon: bool = True
-) -> Callable[[TrainState, jax.Array], dict]:
+    trial: TrialMesh,
+    model: VAE,
+    *,
+    beta: float = 1.0,
+    with_recon: bool = True,
+    masked: bool = False,
+) -> Callable[..., dict]:
     """Compiled eval step: summed ELBO (+ reconstructions) for one batch.
 
     The analog of the reference's ``test`` inner loop
@@ -291,11 +296,20 @@ def make_eval_step(
     caller can image them (``vae-hpo.py:106-116``). Loss-only callers
     (e.g. PBT scoring) pass ``with_recon=False`` to skip materializing
     the (N, input_dim) output.
+
+    ``masked=True`` returns ``eval_fn(state, batch, weights)`` whose
+    ``loss_sum`` is the weight-vector masked sum — the static-shape way
+    to evaluate a test set that doesn't divide the batch size: the final
+    partial batch arrives zero-padded with 0.0 weights
+    (``data.sampler.EvalDataIterator``) and contributes exactly its real
+    rows, so reported test losses cover every row, like the reference's.
     """
+    from multidisttorch_tpu.ops.losses import elbo_loss_weighted_sum
+
     repl = trial.replicated_sharding
     data = trial.batch_sharding
 
-    def eval_fn(state: TrainState, batch: jax.Array):
+    def eval_core(state: TrainState, batch: jax.Array, weights):
         n = batch.shape[0]
         flat = batch.reshape(n, -1)
         mu, logvar = model.apply(
@@ -306,11 +320,24 @@ def make_eval_step(
         recon_logits = model.apply(
             {"params": state.params}, mu, method="decode"
         )
-        loss = elbo_loss_sum(recon_logits, flat, mu, logvar, beta)
+        if weights is None:
+            loss = elbo_loss_sum(recon_logits, flat, mu, logvar, beta)
+        else:
+            loss = elbo_loss_weighted_sum(
+                recon_logits, flat, mu, logvar, weights, beta
+            )
         out = {"loss_sum": loss.astype(jnp.float32)}
         if with_recon:
             out["recon"] = jax.nn.sigmoid(recon_logits.astype(jnp.float32))
         return out
+
+    if masked:
+        return jax.jit(
+            eval_core, in_shardings=(repl, data, data), out_shardings=repl
+        )
+
+    def eval_fn(state: TrainState, batch: jax.Array):
+        return eval_core(state, batch, None)
 
     return jax.jit(eval_fn, in_shardings=(repl, data), out_shardings=repl)
 

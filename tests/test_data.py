@@ -4,6 +4,7 @@ import numpy as np
 import pytest
 
 from multidisttorch_tpu.data.datasets import (
+    Dataset,
     load_mnist,
     synthetic_cifar10,
     synthetic_mnist,
@@ -183,3 +184,77 @@ def test_stream_chunks_crosses_epoch_boundaries():
     flat_got = [batch for chunk in got for batch in chunk]
     for a, b in zip(flat_got, want):
         np.testing.assert_array_equal(a, b)
+
+
+class TestEvalDataIterator:
+    """Full-coverage pad-and-mask eval feed (reference test() consumes
+    every row, /root/reference/vae-hpo.py:101-105)."""
+
+    def test_covers_every_row_in_order_with_padding(self):
+        from multidisttorch_tpu.data.sampler import EvalDataIterator
+
+        ds = synthetic_mnist(20, seed=1)
+        trial = setup_groups(4)[0]  # 2-device data axis
+        it = EvalDataIterator(ds, trial, batch_size=8)
+        assert it.num_batches == 3 and it.num_rows == 20
+        seen, weight_total = [], 0.0
+        for imgs, w in it.batches():
+            imgs, w = np.asarray(imgs), np.asarray(w)
+            assert imgs.shape[0] == 8 and w.shape == (8,)
+            seen.append(imgs[w > 0])
+            weight_total += w.sum()
+            # padding rows are zero
+            np.testing.assert_array_equal(imgs[w == 0], 0.0)
+        assert weight_total == 20
+        np.testing.assert_array_equal(np.concatenate(seen), ds.images)
+
+    def test_smaller_than_one_batch(self):
+        from multidisttorch_tpu.data.sampler import EvalDataIterator
+
+        ds = synthetic_mnist(5, seed=2)
+        trial = setup_groups(4)[1]
+        it = EvalDataIterator(ds, trial, batch_size=16)
+        batches = list(it.batches())
+        assert len(batches) == 1
+        imgs, w = batches[0]
+        assert np.asarray(w).sum() == 5
+
+    def test_with_labels(self):
+        from multidisttorch_tpu.data.sampler import EvalDataIterator
+
+        ds = synthetic_mnist(10, seed=3)
+        trial = setup_groups(8)[0]
+        it = EvalDataIterator(ds, trial, batch_size=8, with_labels=True)
+        (i1, l1, w1), (i2, l2, w2) = list(it.batches())
+        assert np.asarray(l1).shape == (8,)
+        np.testing.assert_array_equal(
+            np.concatenate([np.asarray(l1)[np.asarray(w1) > 0],
+                            np.asarray(l2)[np.asarray(w2) > 0]]),
+            ds.labels,
+        )
+
+    def test_rejects_indivisible_batch_and_empty(self):
+        from multidisttorch_tpu.data.sampler import EvalDataIterator
+
+        ds = synthetic_mnist(10, seed=4)
+        trial = setup_groups(4)[0]  # data axis 2
+        with pytest.raises(ValueError, match="divide evenly"):
+            EvalDataIterator(ds, trial, batch_size=7)
+        empty = Dataset(
+            images=np.zeros((0, 784), np.float32),
+            labels=np.zeros((0,), np.int32),
+            name="empty",
+        )
+        with pytest.raises(ValueError, match="empty"):
+            EvalDataIterator(empty, trial, batch_size=8)
+
+
+def test_chunk_size_validated_eagerly():
+    # ADVICE r1: a bad k must raise at the call site, not at first next().
+    ds = synthetic_mnist(32, seed=5)
+    trial = setup_groups(8)[0]
+    it = TrialDataIterator(ds, trial, 8, use_native=False)
+    with pytest.raises(ValueError, match="chunk size"):
+        it.epoch_chunks(0, 0)
+    with pytest.raises(ValueError, match="chunk size"):
+        it.stream_chunks(-1)

@@ -107,6 +107,40 @@ def test_eval_step_returns_recon_probs():
     assert np.isfinite(float(out["loss_sum"]))
 
 
+def test_masked_eval_covers_every_row_exactly():
+    # Full-test-set parity (reference test(), vae-hpo.py:101-105): the
+    # pad-and-mask eval over ceil(n/batch) padded batches must equal a
+    # dense unmasked eval over all n rows — including n < batch_size.
+    from multidisttorch_tpu.data.datasets import synthetic_mnist
+    from multidisttorch_tpu.data.sampler import EvalDataIterator
+
+    model = VAE(hidden_dim=32, latent_dim=8)
+    tx = optax.adam(1e-3)
+    trial = setup_groups(2)[0]
+    state = create_train_state(trial, model, tx, jax.random.key(0))
+    ev = make_eval_step(trial, model, with_recon=False, masked=True)
+
+    for n_rows in (20, 5):  # 20 = 2.5 batches of 8; 5 < one batch
+        data = synthetic_mnist(n_rows, seed=7)
+        it = EvalDataIterator(data, trial, batch_size=8)
+        assert it.num_batches == -(-n_rows // 8)
+        total = None
+        for batch, w in it.batches():
+            out = ev(state, batch, w)
+            total = out["loss_sum"] if total is None else total + out["loss_sum"]
+        # dense reference: all rows in one unmasked batch on a 1-device
+        # group (no divisibility constraint there)
+        dense_trial = setup_groups(8)[0]
+        dense_state = create_train_state(
+            dense_trial, model, tx, jax.random.key(0)
+        )
+        dense_ev = make_eval_step(dense_trial, model, with_recon=False)
+        dense = dense_ev(dense_state, jnp.asarray(data.images))
+        np.testing.assert_allclose(
+            float(total), float(dense["loss_sum"]), rtol=2e-5
+        )
+
+
 def test_sample_step_shape_and_range():
     model = VAE(hidden_dim=32, latent_dim=8)
     tx = optax.adam(1e-3)
