@@ -41,6 +41,7 @@ import json
 import os
 import threading
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field, asdict
 from typing import Iterator, Optional, Sequence
 
@@ -130,6 +131,7 @@ class _TrialRun:
         verbose: bool = True,
         model_builder=None,
         resume: bool = False,
+        agree_failures: bool = False,
     ):
         if cfg.fused_steps < 1:
             raise ValueError(
@@ -151,10 +153,24 @@ class _TrialRun:
         # multi-process half). Resume restores *state* on all owner
         # processes; only the writer re-reads sidecar metadata.
         self._is_writer = trial.is_writer_process
+        # Uniform across owner processes (drives which programs are
+        # compiled AND dispatched — dispatch gating must never be
+        # writer-local on a process-spanning submesh, or SPMD execution
+        # desynchronizes); the writer-gated flag below controls only
+        # host-side fetch + file writes.
+        self._images_requested = save_images
         self._save_images = save_images and self._is_writer
         self._save_checkpoint = save_checkpoint
         self._verbose = verbose
         self._test_data = test_data
+        # Multi-host failure isolation (resilient sweeps on spanning
+        # submeshes): writer-only host-I/O failures are deferred and
+        # agreed at the epoch boundary via a submesh-scoped reduction
+        # (collectives.group_all_ok), so every owner process kills the
+        # trial identically instead of one process freeing the group
+        # while peers keep stepping it.
+        self._agree = agree_failures
+        self._deferred_error: Optional[BaseException] = None
 
         if model_builder is None:
             model = VAE(hidden_dim=cfg.hidden_dim, latent_dim=cfg.latent_dim)
@@ -276,6 +292,45 @@ class _TrialRun:
     def _log(self, *args):
         if self._verbose:
             log0(*args, trial=self.trial)
+
+    @contextmanager
+    def _guard(self):
+        """Collect writer-only host-I/O failures (image/checkpoint/
+        metrics writes) for epoch-boundary agreement instead of raising
+        on one process of a spanning submesh. No-op outside agreement
+        mode: errors raise at the fault site, reference-honest."""
+        if not self._agree:
+            yield
+            return
+        try:
+            yield
+        except Exception as e:  # noqa: BLE001 — deferred to agreement
+            if self._deferred_error is None:
+                self._deferred_error = e
+
+    def _agree_boundary(self, where: str) -> None:
+        """Epoch-boundary health agreement over the trial submesh.
+
+        Every owner process calls this at the same point in the group's
+        dispatch sequence (deterministic cadence: once per epoch + once
+        at completion). If any owner deferred a failure, ALL owners
+        raise here — the submesh is freed identically everywhere, and
+        unrelated trials never participate (no world barrier; quirk Q3
+        stays fixed). Deterministic compute failures need no agreement:
+        SPMD determinism raises them identically on every owner.
+        """
+        if not self._agree:
+            return
+        from multidisttorch_tpu.parallel.collectives import group_all_ok
+
+        err, self._deferred_error = self._deferred_error, None
+        if not group_all_ok(self.trial, err is None):
+            if err is not None:
+                raise err
+            raise RuntimeError(
+                f"trial {self.cfg.trial_id}: {where} failed on a peer "
+                "owner process (agreed via submesh health reduction)"
+            )
 
     def _write_ckpt(self, host_state, meta: dict) -> None:
         """Background checkpoint write. ``result.checkpoint`` is set only
@@ -424,86 +479,103 @@ class _TrialRun:
                 epoch_record["test_loss"] = test_avg
                 self.result.final_test_loss = test_avg
                 if self._save_images and first_batch is not None:
-                    # input-vs-reconstruction grid (vae-hpo.py:106-116)
-                    n = min(8, first_batch.shape[0])
-                    comparison = np.concatenate(
-                        [first_batch[:n], first_recon[:n]]
-                    )
-                    save_image_grid(
-                        comparison,
-                        os.path.join(
-                            self.out_dir, f"reconstruction_{epoch}.png"
-                        ),
-                        nrow=n,
-                    )
+                    with self._guard():
+                        # input-vs-recon grid (vae-hpo.py:106-116)
+                        n = min(8, first_batch.shape[0])
+                        comparison = np.concatenate(
+                            [first_batch[:n], first_recon[:n]]
+                        )
+                        save_image_grid(
+                            comparison,
+                            os.path.join(
+                                self.out_dir, f"reconstruction_{epoch}.png"
+                            ),
+                            nrow=n,
+                        )
 
-            if self._save_images:
-                # prior-sample grid (vae-hpo.py:163-170)
+            if self._images_requested:
+                # prior-sample grid (vae-hpo.py:163-170). The dispatch is
+                # UNIFORM across owner processes (a jit program on the
+                # submesh — writer-gating it would desynchronize SPMD on
+                # a spanning group); only the fetch + PNG write below are
+                # writer-only.
                 # sample keys live in a disjoint fold_in range (steps
                 # count up from 0; fold_in data must be non-negative)
-                samples = np.asarray(
-                    self.sample_step(
-                        self.state, jax.random.fold_in(self._key, 2**30 + epoch)
-                    )
+                sample_out = self.sample_step(
+                    self.state, jax.random.fold_in(self._key, 2**30 + epoch)
                 )
-                save_image_grid(
-                    samples, os.path.join(self.out_dir, f"sample_{epoch}.png")
-                )
+                if self._save_images:
+                    with self._guard():
+                        save_image_grid(
+                            np.asarray(sample_out),
+                            os.path.join(self.out_dir, f"sample_{epoch}.png"),
+                        )
 
             self.result.history.append(epoch_record)
             self.result.final_train_loss = avg
             if self._save_checkpoint and self._is_writer:
-                # Per-epoch checkpoint = the resume boundary. Keep the
-                # scheduler loop responsive: start the device→host copy
-                # async, yield once so other trials keep dispatching,
-                # then hand the serialize+disk-write to a background
-                # thread. The snapshot is taken before the next epoch's
-                # first step, so donation can't invalidate it.
-                jax.tree.map(lambda x: x.copy_to_host_async(), self.state)
-                yield
-                host_state = jax.device_get(self.state)
-                meta = {
-                    **asdict(cfg),
-                    "completed_epochs": epoch,
-                    # Optimizer-step count at this epoch boundary: resume
-                    # cross-checks it against the restored state so a
-                    # crash landing between the two atomic replaces
-                    # (state newer than sidecar) is detected, not
-                    # silently re-trained.
-                    "step": int(host_state.step),
-                    "history": list(self.result.history),
-                }
-                self._join_ckpt()
-                self._ckpt_thread = threading.Thread(
-                    target=self._write_ckpt,
-                    args=(host_state, meta),
-                    # Non-daemon: interpreter exit waits for the write
-                    # (atexit joins it), so a crash elsewhere in the
-                    # sweep can't kill a checkpoint mid-flight.
-                    daemon=False,
-                )
-                self._ckpt_thread.start()
+                with self._guard():
+                    # Per-epoch checkpoint = the resume boundary. Keep
+                    # the scheduler loop responsive: start the
+                    # device→host copy async, yield once so other trials
+                    # keep dispatching, then hand the serialize+disk-
+                    # write to a background thread. The snapshot is
+                    # taken before the next epoch's first step, so
+                    # donation can't invalidate it.
+                    jax.tree.map(lambda x: x.copy_to_host_async(), self.state)
+                    yield
+                    host_state = jax.device_get(self.state)
+                    meta = {
+                        **asdict(cfg),
+                        "completed_epochs": epoch,
+                        # Optimizer-step count at this epoch boundary:
+                        # resume cross-checks it against the restored
+                        # state so a crash landing between the two
+                        # atomic replaces (state newer than sidecar) is
+                        # detected, not silently re-trained.
+                        "step": int(host_state.step),
+                        "history": list(self.result.history),
+                    }
+                    self._join_ckpt()
+                    self._ckpt_thread = threading.Thread(
+                        target=self._write_ckpt,
+                        args=(host_state, meta),
+                        # Non-daemon: interpreter exit waits for the
+                        # write (atexit joins it), so a crash elsewhere
+                        # in the sweep can't kill a checkpoint
+                        # mid-flight.
+                        daemon=False,
+                    )
+                    self._ckpt_thread.start()
+            # One agreement per epoch: all owners of a spanning submesh
+            # kill the trial together if any of them deferred a failure.
+            self._agree_boundary(f"epoch {epoch} boundary work")
 
         # drain the pipeline so wall-clock covers real completion
         jax.block_until_ready(self.state.params)
-        self._join_ckpt()
+        with self._guard():
+            self._join_ckpt()
         self.result.wall_s = time.time() - t0
         self.result.steps = step_no
         if self._is_writer:
-            os.makedirs(self.out_dir, exist_ok=True)
-            with open(os.path.join(self.out_dir, "metrics.json"), "w") as f:
-                json.dump(
-                    {
-                        "trial_id": self.result.trial_id,
-                        "group_id": self.result.group_id,
-                        "config": asdict(cfg),
-                        "history": self.result.history,
-                        "wall_s": self.result.wall_s,
-                        "steps": self.result.steps,
-                    },
-                    f,
-                    indent=2,
-                )
+            with self._guard():
+                os.makedirs(self.out_dir, exist_ok=True)
+                with open(
+                    os.path.join(self.out_dir, "metrics.json"), "w"
+                ) as f:
+                    json.dump(
+                        {
+                            "trial_id": self.result.trial_id,
+                            "group_id": self.result.group_id,
+                            "config": asdict(cfg),
+                            "history": self.result.history,
+                            "wall_s": self.result.wall_s,
+                            "steps": self.result.steps,
+                        },
+                        f,
+                        indent=2,
+                    )
+        self._agree_boundary("completion work")
         self._log(f"Done. time: {self.result.wall_s:f}")
 
 
@@ -544,7 +616,12 @@ def run_hpo(
     ``resilient=True`` isolates failures: a trial raising marks its
     result ``status="failed"`` (exception text in ``.error``), frees the
     submesh, and the sweep continues. Default re-raises (honest errors,
-    SURVEY.md Q8).
+    SURVEY.md Q8). Works multi-controller too: deterministic failures
+    resolve identically on every owner process by SPMD determinism, and
+    writer-only host-I/O failures are agreed at setup/epoch boundaries
+    through a submesh-scoped health reduction — one trial's death frees
+    its submesh on every owning process with no world barrier (contrast
+    the reference, where a failed rank hangs the world's collectives).
 
     ``resume=True`` restores each trial from its per-epoch checkpoint
     under ``{out_dir}/trial-{id}/`` (skipping fully-trained trials), so
@@ -609,15 +686,26 @@ def _run_hpo_body(
             "(fewer configs than groups would idle submeshes; carve "
             "fewer groups instead)"
         )
-    if resilient and jax.process_count() > 1:
-        raise NotImplementedError(
-            "resilient=True requires single-controller mode: failure "
-            "handling is process-local, so on a multi-process submesh "
-            "one process would free the group while its peers keep "
-            "stepping the failed trial, desynchronizing collectives. "
-            "Multi-host failure isolation needs a cross-process "
-            "agreement protocol — planned."
-        )
+    # Multi-host failure isolation: failures must resolve identically on
+    # every process owning a trial's submesh, or one process frees the
+    # group while peers keep stepping it (desynchronized collectives —
+    # the reference's failure mode is worse still: a dead rank hangs the
+    # world, SURVEY.md §5). Two mechanisms, by failure class:
+    #  - Deterministic failures (bad config, model build, NaN guards,
+    #    data exhaustion): SPMD determinism raises them at the same
+    #    dispatch point on every owner — identical local handling IS the
+    #    agreement.
+    #  - Writer-only host-I/O failures (image/checkpoint/metrics
+    #    writes): deferred by _TrialRun._guard and agreed at setup /
+    #    epoch boundaries via a submesh-scoped health reduction
+    #    (collectives.group_all_ok) — no world barrier, unrelated trials
+    #    unaffected.
+    # Out of scope (documented): asymmetric failures *inside* the
+    # dispatch stream (host OOM, device loss mid-epoch) — those desync
+    # the submesh's program sequence itself and need runtime-level
+    # preemption, which no SPMD framework recovers from at this layer.
+    def needs_agreement(g: TrialMesh) -> bool:
+        return resilient and jax.process_count() > 1 and g.spans_processes
 
     def make_run(trial: TrialMesh, cfg: TrialConfig) -> _TrialRun:
         return _TrialRun(
@@ -637,6 +725,7 @@ def _run_hpo_body(
             verbose=verbose,
             model_builder=model_builder,
             resume=resume,
+            agree_failures=needs_agreement(trial),
         )
 
     # Queue configs per group. Single-controller: one shared queue,
@@ -664,21 +753,44 @@ def _run_hpo_body(
         q = queue_of(g)
         while q:
             i, cfg = q.pop(0)
+            err: Optional[BaseException] = None
+            run: Optional[_TrialRun] = None
             try:
                 run = make_run(g, cfg)
             except Exception as e:  # noqa: BLE001 — setup failure isolation
+                err = e
+            if needs_agreement(g):
+                # Setup agreement: owners of a spanning submesh must all
+                # start stepping or all skip — an asymmetric setup
+                # failure (e.g. one host's data path) would otherwise
+                # leave peers dispatching a trial that never runs here.
+                from multidisttorch_tpu.parallel.collectives import (
+                    group_all_ok,
+                )
+
+                ok = group_all_ok(g, err is None)
+            else:
+                ok = err is None
+            if not ok:
+                error_text = (
+                    f"{type(err).__name__}: {err}"
+                    if err is not None
+                    else "setup failed on a peer owner process"
+                )
                 results[i] = TrialResult(
                     trial_id=cfg.trial_id,
                     group_id=g.group_id,
                     config=cfg,
                     status="failed",
-                    error=f"{type(e).__name__}: {e}",
+                    error=error_text,
                 )
                 if not resilient:
-                    raise
+                    if err is not None:
+                        raise err
+                    raise RuntimeError(error_text)
                 log0(
                     f"Trial {cfg.trial_id} FAILED at setup "
-                    f"({results[i].error}); sweep continues",
+                    f"({error_text}); sweep continues",
                     trial=g,
                 )
                 continue

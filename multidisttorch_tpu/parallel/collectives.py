@@ -101,3 +101,48 @@ def group_psum(trial: TrialMesh, x):
 def group_pmean(trial: TrialMesh, x):
     """Mean per-device shards across the group (DDP gradient averaging)."""
     return _reduce_fn(trial.mesh, "pmean")(x)
+
+
+@lru_cache(maxsize=None)
+def _sum_flags_fn(mesh: Mesh):
+    from jax.sharding import NamedSharding
+
+    return jax.jit(
+        jnp.sum, out_shardings=NamedSharding(mesh, P())
+    )
+
+
+def group_all_ok(trial: TrialMesh, ok: bool) -> bool:
+    """Cross-process health agreement scoped to ONE trial submesh.
+
+    Returns True iff every process owning a device of this group called
+    with ``ok=True``. The TPU-native failure-detection primitive: the
+    health bit rides the same submesh the trial runs on — one tiny SPMD
+    reduction over the group's devices, touching only the group's owner
+    processes. No world-scoped barrier, so unrelated trials stay
+    decoupled (quirk Q3 stays fixed; contrast the reference, where a
+    failed rank simply hangs the world's collectives — SURVEY.md §5
+    "failure detection").
+
+    Collective contract: every owner process must call this at the same
+    point in its dispatch sequence for this group (the HPO driver calls
+    it at trial setup and at each epoch boundary — deterministic
+    cadence).
+    """
+    import numpy as np
+
+    n = trial.size
+    # One element per member device, each process filling its
+    # addressable shards with its own health bit.
+    sharding = trial.sharding(tuple(trial.mesh.axis_names))
+    local = np.zeros(1, np.float32) if ok else np.ones(1, np.float32)
+    if jax.process_count() == 1:
+        flags = jax.device_put(
+            np.full(n, local[0], np.float32), sharding
+        )
+    else:
+        flags = jax.make_array_from_callback(
+            (n,), sharding, lambda idx: local
+        )
+    failed = _sum_flags_fn(trial.mesh)(flags)
+    return float(failed) == 0.0

@@ -130,6 +130,19 @@ class TrialMesh:
                 return i
         return -1
 
+    @property
+    def owner_processes(self) -> frozenset[int]:
+        """Process indices owning at least one device of this group —
+        global device metadata, so every process computes the same set."""
+        return frozenset(d.process_index for d in self.devices)
+
+    @property
+    def spans_processes(self) -> bool:
+        """Whether this group's devices live on more than one process
+        (when True, per-trial failure handling needs the cross-process
+        agreement in ``collectives.group_all_ok``)."""
+        return len(self.owner_processes) > 1
+
     # --- shardings: the pjit-native face of "this group's communicator" ---
 
     @property

@@ -84,6 +84,102 @@ def main():
             "wrote_ckpt": bool(r.checkpoint),
         }
 
+    elif mode == "resilient_split":
+        # Failure isolation with wholly-owned groups: config 1 fails
+        # deterministically (model_builder raises on its config on every
+        # owner — here group 1's sole owner is process 1); the sweep
+        # must complete everywhere with trial 1 marked failed and the
+        # elastic queue still serving trial 2 on group 0.
+        from multidisttorch_tpu.hpo.driver import TrialConfig, run_hpo
+        from multidisttorch_tpu.models.vae import VAE
+
+        def builder(cfg):
+            if cfg.trial_id == 1:
+                raise RuntimeError("injected deterministic failure")
+            return VAE(hidden_dim=cfg.hidden_dim, latent_dim=cfg.latent_dim)
+
+        configs = [
+            TrialConfig(t, epochs=1, batch_size=16, hidden_dim=16,
+                        latent_dim=4, seed=t)
+            for t in range(3)
+        ]
+        results = run_hpo(
+            configs, train, test, out_dir=out_dir, num_groups=2,
+            verbose=False, save_images=False, save_checkpoints=False,
+            model_builder=builder, resilient=True,
+        )
+        summary = {
+            "pid": pid,
+            "statuses": {r.trial_id: r.status for r in results},
+            "errors": {r.trial_id: (r.error or "")[:120] for r in results},
+        }
+
+    elif mode == "resilient_span_io":
+        # Failure isolation on a SPANNING submesh with an ASYMMETRIC
+        # writer-only failure: the image write raises on the writer
+        # process only (trial 0). The epoch-boundary health reduction
+        # must make BOTH owner processes kill trial 0 and proceed to
+        # trial 1 — the exact scenario that desynchronizes collectives
+        # without cross-process agreement.
+        from multidisttorch_tpu.hpo import driver as drv
+        from multidisttorch_tpu.hpo.driver import TrialConfig, run_hpo
+
+        real_save = drv.save_image_grid
+
+        def exploding_save(arr, path, **kw):
+            if "trial-0" in path:
+                raise OSError("injected writer-only disk failure")
+            return real_save(arr, path, **kw)
+
+        drv.save_image_grid = exploding_save
+        configs = [
+            TrialConfig(t, epochs=2, batch_size=16, hidden_dim=16,
+                        latent_dim=4, seed=t)
+            for t in range(2)
+        ]
+        results = run_hpo(
+            configs, train, test, out_dir=out_dir, num_groups=1,
+            verbose=False, save_images=True, save_checkpoints=False,
+            resilient=True,
+        )
+        summary = {
+            "pid": pid,
+            "statuses": {r.trial_id: r.status for r in results},
+            "errors": {r.trial_id: (r.error or "")[:120] for r in results},
+            "trial1_steps": next(
+                r.steps for r in results if r.trial_id == 1
+            ),
+        }
+
+    elif mode == "resilient_span_setup":
+        # Asymmetric SETUP failure on a spanning submesh: the model
+        # builder raises on process 1 only for trial 0. The setup
+        # agreement must keep process 0 from stepping a trial its peer
+        # never constructed; both must then run trial 1 to completion.
+        from multidisttorch_tpu.hpo.driver import TrialConfig, run_hpo
+        from multidisttorch_tpu.models.vae import VAE
+
+        def builder(cfg):
+            if cfg.trial_id == 0 and jax.process_index() == 1:
+                raise RuntimeError("injected one-process setup failure")
+            return VAE(hidden_dim=cfg.hidden_dim, latent_dim=cfg.latent_dim)
+
+        configs = [
+            TrialConfig(t, epochs=1, batch_size=16, hidden_dim=16,
+                        latent_dim=4, seed=t)
+            for t in range(2)
+        ]
+        results = run_hpo(
+            configs, train, test, out_dir=out_dir, num_groups=1,
+            verbose=False, save_images=False, save_checkpoints=False,
+            model_builder=builder, resilient=True,
+        )
+        summary = {
+            "pid": pid,
+            "statuses": {r.trial_id: r.status for r in results},
+            "errors": {r.trial_id: (r.error or "")[:120] for r in results},
+        }
+
     elif mode == "pbt":
         # Population of 2, one member per process; cross-process exploit
         # moves weights via broadcast_one_to_all. Both processes must

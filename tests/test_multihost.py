@@ -113,6 +113,44 @@ def test_spanning_group_trains_identically_on_both_processes(tmp_path):
 
 
 @pytest.mark.multihost
+def test_resilient_split_groups_isolate_deterministic_failure(tmp_path):
+    r0, r1 = _launch("resilient_split", tmp_path)
+    # Trial 1 (group 1, wholly owned by process 1) fails
+    # deterministically; the sweep completes everywhere, and group 0's
+    # elastic queue still serves trial 2.
+    assert r0["statuses"] == {"0": "completed", "2": "completed"}
+    assert r1["statuses"] == {"1": "failed"}
+    assert "injected deterministic failure" in r1["errors"]["1"]
+
+
+@pytest.mark.multihost
+def test_resilient_spanning_group_agrees_on_writer_only_failure(tmp_path):
+    r0, r1 = _launch("resilient_span_io", tmp_path)
+    # The image write failed on the WRITER process only; the
+    # epoch-boundary health reduction must kill trial 0 on BOTH owner
+    # processes (without it, rank 1 keeps stepping trial 0 while rank 0
+    # has freed the submesh — desynchronized collectives / hang). Both
+    # must then complete trial 1 on the freed submesh.
+    for r in (r0, r1):
+        assert r["statuses"] == {"0": "failed", "1": "completed"}, r
+        assert r["trial1_steps"] == 16
+    # Rank 0 carries the real error; rank 1 learned of it via agreement.
+    assert "injected writer-only disk failure" in r0["errors"]["0"]
+    assert "peer" in r1["errors"]["0"] or "injected" in r1["errors"]["0"]
+
+
+@pytest.mark.multihost
+def test_resilient_spanning_group_agrees_on_asymmetric_setup_failure(tmp_path):
+    r0, r1 = _launch("resilient_span_setup", tmp_path)
+    # Setup raised on process 1 only; the setup agreement keeps process
+    # 0 from stepping a trial its peer never constructed.
+    for r in (r0, r1):
+        assert r["statuses"] == {"0": "failed", "1": "completed"}, r
+    assert "injected one-process setup failure" in r1["errors"]["0"]
+    assert "peer" in r0["errors"]["0"]
+
+
+@pytest.mark.multihost
 def test_pbt_cross_process_exploit_agrees(tmp_path):
     r0, r1 = _launch("pbt", tmp_path)
     # Global decisions (scores, ranking, exploit targets, perturbed lrs)
