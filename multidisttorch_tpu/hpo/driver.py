@@ -102,6 +102,13 @@ class TrialResult:
     checkpoint: str = ""
     status: str = "completed"  # "completed" | "failed" | "resumed_complete"
     error: str = ""
+    # Data provenance: which dataset the trial actually trained on, and
+    # whether it was the synthetic zero-egress stand-in. The reference
+    # always trains on real MNIST (vae-hpo.py:133-144); this repo can
+    # silently degrade to synthetic (data/datasets.py), so a trial's
+    # recorded metrics must say which world they came from.
+    dataset: str = ""
+    dataset_synthetic: bool = False
 
 
 class _TrialRun:
@@ -146,6 +153,8 @@ class _TrialRun:
             group_id=trial.group_id,
             config=cfg,
             out_dir=self.out_dir,
+            dataset=train_data.name,
+            dataset_synthetic=train_data.synthetic,
         )
         # Artifacts (images, checkpoints, metrics.json) are written by
         # exactly one process per group — on a shared filesystem,
@@ -568,6 +577,8 @@ class _TrialRun:
                             "trial_id": self.result.trial_id,
                             "group_id": self.result.group_id,
                             "config": asdict(cfg),
+                            "dataset": self.result.dataset,
+                            "dataset_synthetic": self.result.dataset_synthetic,
                             "history": self.result.history,
                             "wall_s": self.result.wall_s,
                             "steps": self.result.steps,

@@ -104,6 +104,12 @@ def test_per_trial_output_dirs_no_collision(tmp_path, data):
             metrics = json.load(f)
         assert metrics["trial_id"] == r.trial_id
         assert len(metrics["history"]) == 1
+        # Data provenance (round-4): a synthetic-data trial must say so
+        # in its own recorded metrics, not just in bench artifacts.
+        assert metrics["dataset"] == "synthetic-mnist"
+        assert metrics["dataset_synthetic"] is True
+        assert r.dataset == "synthetic-mnist"
+        assert r.dataset_synthetic is True
 
 
 def test_trial_config_generalizes_hpo_knobs(tmp_path, data):
