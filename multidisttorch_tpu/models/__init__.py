@@ -1,3 +1,7 @@
-from multidisttorch_tpu.models.conv_vae import ConvVAE
-from multidisttorch_tpu.models.resnet import ResNet, ResNet18
+from multidisttorch_tpu.models.conv_vae import ConvVAE, conv_tp_shardings
+from multidisttorch_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    resnet_tp_shardings,
+)
 from multidisttorch_tpu.models.vae import VAE, init_vae_params, vae_tp_shardings

@@ -100,3 +100,54 @@ class ConvVAE(nn.Module):
         mu, logvar = self.encode(x)
         z = self.reparameterize(mu, logvar)
         return self.decode(z), mu, logvar
+
+
+def conv_tp_shardings(trial, model: ConvVAE):
+    """Megatron-style tensor-parallel shardings for the ConvVAE tree.
+
+    Channel-dimension analog of ``models.vae.vae_tp_shardings`` for a
+    2-D ``(data, model)`` trial submesh: conv/deconv layers alternate
+    column-parallel (output channels sharded — kernel dim 3, the feature
+    axis of flax's ``(kh, kw, in, out)`` layout) and row-parallel (input
+    channels sharded — kernel dim 2), so activations stay channel-sharded
+    between each pair and GSPMD inserts one psum per row-parallel layer.
+    Pairs: (enc0→enc1), (enc2→mu/logvar heads), (proj→dec0),
+    (dec1→out). The latent bottleneck and the row-parallel outputs are
+    replicated. BASELINE.md config 3 ("stress per-trial all-reduce") is
+    the target workload; the reference has no TP at all (SURVEY.md §2c).
+    """
+    from multidisttorch_tpu.parallel.mesh import MODEL_AXIS
+
+    m = trial.model_size
+    if model.base_channels % m:
+        raise ValueError(
+            f"base_channels={model.base_channels} not divisible by the "
+            f"model axis ({m}) — every conv stage's channels must split"
+        )
+    col_conv = lambda: {
+        "kernel": trial.sharding(None, None, None, MODEL_AXIS),
+        "bias": trial.sharding(MODEL_AXIS),
+    }
+    row_conv = lambda: {
+        "kernel": trial.sharding(None, None, MODEL_AXIS, None),
+        "bias": trial.sharding(),
+    }
+    col_dense = lambda: {
+        "kernel": trial.sharding(None, MODEL_AXIS),
+        "bias": trial.sharding(MODEL_AXIS),
+    }
+    row_dense = lambda: {
+        "kernel": trial.sharding(MODEL_AXIS, None),
+        "bias": trial.sharding(),
+    }
+    return {
+        "enc0": col_conv(),
+        "enc1": row_conv(),
+        "enc2": col_conv(),
+        "mu": row_dense(),
+        "logvar": row_dense(),
+        "proj": col_dense(),
+        "dec0": row_conv(),
+        "dec1": col_conv(),
+        "out": row_conv(),
+    }

@@ -21,6 +21,7 @@ from functools import partial
 from typing import Any, Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 
@@ -102,3 +103,149 @@ class ResNet(nn.Module):
 
 def ResNet18(**kwargs) -> ResNet:
     return ResNet(stage_sizes=(2, 2, 2, 2), **kwargs)
+
+
+class ResNetStage(nn.Module):
+    """One contiguous chunk of a :class:`ResNet` for pipeline parallelism.
+
+    The first stage carries the stem (+input reshape), the last carries
+    the pool + classifier head; activation shapes CHANGE across stage
+    boundaries (spatial halving, channel doubling), which is exactly
+    what ``parallel.pipeline.pipeline_apply_stages``'s padded carry
+    exists for.
+    """
+
+    blocks: Sequence[tuple[int, int]]  # (channels, strides) per block
+    include_stem: bool = False
+    include_head: bool = False
+    num_classes: int = 10
+    base_channels: int = 64
+    image_hw: int = 32
+    image_channels: int = 3
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if self.include_stem:
+            if x.ndim == 2:
+                x = x.reshape(
+                    (-1, self.image_hw, self.image_hw, self.image_channels)
+                )
+            x = x.astype(self.dtype)
+            x = nn.Conv(
+                self.base_channels, (3, 3), dtype=self.dtype,
+                param_dtype=jnp.float32, use_bias=False, name="stem",
+            )(x)
+            x = nn.relu(
+                nn.GroupNorm(
+                    num_groups=min(32, self.base_channels),
+                    dtype=self.dtype,
+                    param_dtype=jnp.float32,
+                )(x)
+            )
+        for channels, strides in self.blocks:
+            x = BasicBlock(
+                channels=channels, strides=strides, dtype=self.dtype
+            )(x)
+        if self.include_head:
+            x = jnp.mean(x, axis=(1, 2))
+            x = nn.Dense(
+                self.num_classes, dtype=jnp.float32,
+                param_dtype=jnp.float32, name="head",
+            )(x)
+        return x
+
+
+def resnet_pipeline_stages(
+    model: ResNet, num_stages: int
+) -> list[ResNetStage]:
+    """Split a :class:`ResNet` config into ``num_stages`` pipeline-stage
+    modules (balanced contiguous block runs; stage 0 takes the stem, the
+    last stage the head). Feed the modules' ``.apply`` + per-stage params
+    to ``parallel.pipeline.pipeline_apply_stages`` — see
+    ``tests/test_pipeline.py`` for the end-to-end DP x PP training path.
+    """
+    if num_stages < 1:
+        raise ValueError(f"num_stages must be >= 1, got {num_stages}")
+    blocks: list[tuple[int, int]] = []
+    for stage, size in enumerate(model.stage_sizes):
+        for block in range(size):
+            strides = 2 if stage > 0 and block == 0 else 1
+            blocks.append((model.base_channels * (2**stage), strides))
+    if num_stages > len(blocks):
+        raise ValueError(
+            f"cannot split {len(blocks)} blocks into {num_stages} stages"
+        )
+    per, rem = divmod(len(blocks), num_stages)
+    chunks, off = [], 0
+    for s in range(num_stages):
+        take = per + (1 if s < rem else 0)
+        chunks.append(tuple(blocks[off : off + take]))
+        off += take
+    common = dict(
+        num_classes=model.num_classes,
+        base_channels=model.base_channels,
+        image_hw=model.image_hw,
+        image_channels=model.image_channels,
+        dtype=model.dtype,
+    )
+    return [
+        ResNetStage(
+            blocks=chunk,
+            include_stem=(s == 0),
+            include_head=(s == num_stages - 1),
+            **common,
+        )
+        for s, chunk in enumerate(chunks)
+    ]
+
+
+def resnet_tp_shardings(trial, model: ResNet):
+    """Megatron-style tensor-parallel shardings for a ResNet param tree.
+
+    Within every :class:`BasicBlock`: the first 3x3 conv is
+    column-parallel (output channels sharded, its GroupNorm's
+    scale/bias sharded to match), the second 3x3 conv row-parallel
+    (input channels sharded; GSPMD closes the pair with one psum), so
+    each block costs exactly one model-axis all-reduce — the Megatron
+    recipe applied to residual blocks. The projection shortcut, stem,
+    top norm, and classifier head stay replicated: they sit at layout
+    joins (residual adds, global pool) where sharding would only buy a
+    reshard. BASELINE.md config 4 is the workload; the reference is
+    DP-only (SURVEY.md §2c).
+
+    Built by walking the param tree's structure (``jax.eval_shape`` —
+    free), so it stays correct for any ``stage_sizes`` including blocks
+    with/without projection shortcuts.
+    """
+    from multidisttorch_tpu.parallel.mesh import MODEL_AXIS
+
+    m = trial.model_size
+    if model.base_channels % m:
+        raise ValueError(
+            f"base_channels={model.base_channels} not divisible by the "
+            f"model axis ({m}) — every stage's channels must split"
+        )
+    shapes = jax.eval_shape(
+        model.init,
+        {"params": jax.random.key(0)},
+        jnp.zeros((1, model.input_dim), jnp.float32),
+    )["params"]
+    col_kernel = trial.sharding(None, None, None, MODEL_AXIS)
+    row_kernel = trial.sharding(None, None, MODEL_AXIS, None)
+    shard_vec = trial.sharding(MODEL_AXIS)
+    repl = trial.sharding()
+
+    def rule(path, _leaf):
+        keys = [p.key for p in path]
+        if keys[0].startswith("BasicBlock"):
+            sub = keys[1]
+            if sub == "Conv_0":
+                return col_kernel
+            if sub == "GroupNorm_0":
+                return shard_vec
+            if sub == "Conv_1":
+                return row_kernel
+        return repl
+
+    return jax.tree_util.tree_map_with_path(rule, shapes)

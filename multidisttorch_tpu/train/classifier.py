@@ -27,14 +27,29 @@ def create_classifier_state(
     model: Any,
     tx: optax.GradientTransformation,
     rng: jax.Array,
+    param_shardings: Any = None,
 ) -> TrainState:
+    """Initialize and place a classifier state on the trial submesh.
+
+    ``param_shardings`` (e.g. ``models.resnet.resnet_tp_shardings``)
+    shards weights over the submesh's model axis instead of the default
+    DDP-style replication — same contract as
+    ``train.steps.create_train_state``, including the eager optimizer
+    init that lets each Adam moment inherit its weight's sharding.
+    """
+    from multidisttorch_tpu.train.steps import place_sharded_state
+
     params = model.init(
         {"params": rng}, jnp.zeros((1, model.input_dim), jnp.float32)
     )["params"]
-    state = TrainState(
-        params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32)
-    )
-    return trial.device_put(state)
+    if param_shardings is None:
+        state = TrainState(
+            params=params,
+            opt_state=tx.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+        return trial.device_put(state)
+    return place_sharded_state(trial, params, tx, param_shardings)
 
 
 def _build_classifier_step_fn(
@@ -66,21 +81,35 @@ def _build_classifier_step_fn(
 
 
 def make_classifier_train_step(
-    trial: TrialMesh, model: Any, tx: optax.GradientTransformation
+    trial: TrialMesh,
+    model: Any,
+    tx: optax.GradientTransformation,
+    *,
+    shardings: Any = None,
 ) -> Callable:
-    """``step(state, images, labels) -> (state, {loss, accuracy})``."""
+    """``step(state, images, labels) -> (state, {loss, accuracy})``.
+
+    ``shardings`` (from ``train.steps.state_shardings`` on a
+    tensor-parallel state) pins the state layout across steps, same as
+    the VAE step builders.
+    """
     repl = trial.replicated_sharding
     data = trial.batch_sharding
+    state_sh = repl if shardings is None else shardings
     return jax.jit(
         _build_classifier_step_fn(model, tx),
-        in_shardings=(repl, data, data),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sh, data, data),
+        out_shardings=(state_sh, repl),
         donate_argnums=(0,),
     )
 
 
 def make_classifier_multi_step(
-    trial: TrialMesh, model: Any, tx: optax.GradientTransformation
+    trial: TrialMesh,
+    model: Any,
+    tx: optax.GradientTransformation,
+    *,
+    shardings: Any = None,
 ) -> Callable:
     """K chained classifier train steps in ONE dispatch (``lax.scan``) —
     the labeled-data analog of ``train.steps.make_multi_step``.
@@ -89,11 +118,15 @@ def make_classifier_multi_step(
     ``images``/``labels`` stacked as ``(K, batch, ...)`` (the sampler's
     ``epoch_chunks``/``stream_chunks`` shapes, sharded over the data
     axis on dim 1); metrics are per-step arrays of shape ``(K,)``.
+    ``shardings`` pins a tensor-parallel state's layout, same as
+    :func:`make_classifier_train_step` — without it a TP state would be
+    silently resharded to replicated on every fused dispatch.
     """
     from multidisttorch_tpu.parallel.mesh import DATA_AXIS
 
     repl = trial.replicated_sharding
     chunk = trial.sharding(None, DATA_AXIS)
+    state_sh = repl if shardings is None else shardings
     step_fn = _build_classifier_step_fn(model, tx)
 
     def multi_fn(state: TrainState, images: jax.Array, labels: jax.Array):
@@ -106,15 +139,18 @@ def make_classifier_multi_step(
 
     return jax.jit(
         multi_fn,
-        in_shardings=(repl, chunk, chunk),
-        out_shardings=(repl, repl),
+        in_shardings=(state_sh, chunk, chunk),
+        out_shardings=(state_sh, repl),
         donate_argnums=(0,),
     )
 
 
-def make_classifier_eval_step(trial: TrialMesh, model: Any) -> Callable:
+def make_classifier_eval_step(
+    trial: TrialMesh, model: Any, *, shardings: Any = None
+) -> Callable:
     repl = trial.replicated_sharding
     data = trial.batch_sharding
+    state_sh = repl if shardings is None else shardings
 
     def eval_fn(state: TrainState, images: jax.Array, labels: jax.Array):
         logits = model.apply({"params": state.params}, images)
@@ -124,4 +160,6 @@ def make_classifier_eval_step(trial: TrialMesh, model: Any) -> Callable:
         )
         return {"loss": loss.astype(jnp.float32), "correct": correct}
 
-    return jax.jit(eval_fn, in_shardings=(repl, data, data), out_shardings=repl)
+    return jax.jit(
+        eval_fn, in_shardings=(state_sh, data, data), out_shardings=repl
+    )

@@ -70,6 +70,43 @@ def build_train_state(
     )
 
 
+def place_sharded_state(
+    trial: TrialMesh,
+    params: Any,
+    tx: optax.GradientTransformation,
+    param_shardings: Any,
+) -> TrainState:
+    """Place an initialized param tree as a weight-sharded TrainState.
+
+    The one copy of the tensor-parallel placement recipe (shared by the
+    VAE and classifier state creators): params placed per
+    ``param_shardings``; the optimizer state initialized *eagerly* so
+    computation-follows-data gives each Adam moment its weight's
+    sharding — no hand-written moment shardings. (Do NOT jit the init:
+    jit constant-folds the zeros and drops the sharding.) Scalar opt
+    leaves with no input dependence (Adam's count) come back
+    single-device — those are pinned replicated on the submesh.
+    """
+    from jax.sharding import NamedSharding
+
+    params = jax.device_put(params, param_shardings)
+    opt_state = jax.tree.map(
+        lambda x: (
+            x
+            if isinstance(getattr(x, "sharding", None), NamedSharding)
+            else trial.device_put(x)
+        ),
+        tx.init(params),
+    )
+    return TrainState(
+        params=params,
+        opt_state=opt_state,
+        step=jax.device_put(
+            jnp.zeros((), jnp.int32), trial.replicated_sharding
+        ),
+    )
+
+
 def create_train_state(
     trial: TrialMesh,
     model: VAE,
@@ -85,42 +122,17 @@ def create_train_state(
     member device. Default is DDP-style full replication;
     ``param_shardings`` (a pytree of ``NamedSharding`` matching the
     param tree, e.g. ``models.vae.vae_tp_shardings``) instead shards
-    weights over the submesh's model axis, and the optimizer state is
-    initialized *eagerly* so computation-follows-data gives each Adam
-    moment its weight's sharding — no hand-written moment shardings.
-    (Do NOT jit the init: jit constant-folds the zeros and drops the
-    sharding.)
+    weights over the submesh's model axis via
+    :func:`place_sharded_state`.
     """
     if param_shardings is None:
         return trial.device_put(build_train_state(model, tx, rng))
-
-    from jax.sharding import NamedSharding
 
     params = model.init(
         {"params": rng, "reparam": rng},
         jnp.zeros((1, model.input_dim), jnp.float32),
     )["params"]
-    params = jax.device_put(params, param_shardings)
-    # Eager init: computation-follows-data gives each Adam moment its
-    # weight's sharding (a jit'd init would constant-fold the zeros and
-    # drop it). Scalar leaves with no input dependence (Adam's count)
-    # come back single-device — pin those replicated on the submesh.
-    opt_state = tx.init(params)
-    opt_state = jax.tree.map(
-        lambda x: (
-            x
-            if isinstance(getattr(x, "sharding", None), NamedSharding)
-            else trial.device_put(x)
-        ),
-        opt_state,
-    )
-    return TrainState(
-        params=params,
-        opt_state=opt_state,
-        step=jax.device_put(
-            jnp.zeros((), jnp.int32), trial.replicated_sharding
-        ),
-    )
+    return place_sharded_state(trial, params, tx, param_shardings)
 
 
 def state_shardings(state: TrainState) -> TrainState:
@@ -287,6 +299,7 @@ def make_eval_step(
     beta: float = 1.0,
     with_recon: bool = True,
     masked: bool = False,
+    sampled: bool = False,
 ) -> Callable[..., dict]:
     """Compiled eval step: summed ELBO (+ reconstructions) for one batch.
 
@@ -303,23 +316,33 @@ def make_eval_step(
     partial batch arrives zero-padded with 0.0 weights
     (``data.sampler.EvalDataIterator``) and contributes exactly its real
     rows, so reported test losses cover every row, like the reference's.
+
+    ``sampled=True`` appends an ``rng`` argument and evaluates the
+    reference's exact semantics — the full sampled forward, z drawn from
+    the posterior (``vae-hpo.py:101-105`` calls ``model(data)``, which
+    reparameterizes, ``vae-hpo.py:42-45``) — for apples-to-apples test
+    losses against the reference. Default stays the posterior mean:
+    deterministic, and a strictly tighter bound.
     """
     from multidisttorch_tpu.ops.losses import elbo_loss_weighted_sum
 
     repl = trial.replicated_sharding
     data = trial.batch_sharding
 
-    def eval_core(state: TrainState, batch: jax.Array, weights):
+    def eval_core(state: TrainState, batch: jax.Array, weights, rng=None):
         n = batch.shape[0]
         flat = batch.reshape(n, -1)
-        mu, logvar = model.apply(
-            {"params": state.params}, batch, method="encode"
-        )
-        # Eval uses the posterior mean (no sampling): deterministic, and
-        # a strictly tighter bound than the reference's sampled eval.
-        recon_logits = model.apply(
-            {"params": state.params}, mu, method="decode"
-        )
+        if sampled:
+            recon_logits, mu, logvar = model.apply(
+                {"params": state.params}, batch, rngs={"reparam": rng}
+            )
+        else:
+            mu, logvar = model.apply(
+                {"params": state.params}, batch, method="encode"
+            )
+            recon_logits = model.apply(
+                {"params": state.params}, mu, method="decode"
+            )
         if weights is None:
             loss = elbo_loss_sum(recon_logits, flat, mu, logvar, beta)
         else:
@@ -331,9 +354,25 @@ def make_eval_step(
             out["recon"] = jax.nn.sigmoid(recon_logits.astype(jnp.float32))
         return out
 
-    if masked:
+    if masked and sampled:
         return jax.jit(
-            eval_core, in_shardings=(repl, data, data), out_shardings=repl
+            eval_core,
+            in_shardings=(repl, data, data, repl),
+            out_shardings=repl,
+        )
+    if masked:
+        def eval_masked(state: TrainState, batch: jax.Array, weights):
+            return eval_core(state, batch, weights)
+
+        return jax.jit(
+            eval_masked, in_shardings=(repl, data, data), out_shardings=repl
+        )
+    if sampled:
+        def eval_sampled_fn(state: TrainState, batch: jax.Array, rng):
+            return eval_core(state, batch, None, rng)
+
+        return jax.jit(
+            eval_sampled_fn, in_shardings=(repl, data, repl), out_shardings=repl
         )
 
     def eval_fn(state: TrainState, batch: jax.Array):

@@ -10,7 +10,13 @@ import numpy as np
 import optax
 import pytest
 
+from multidisttorch_tpu.models.conv_vae import ConvVAE, conv_tp_shardings
+from multidisttorch_tpu.models.resnet import ResNet, resnet_tp_shardings
 from multidisttorch_tpu.models.vae import VAE, vae_tp_shardings
+from multidisttorch_tpu.train.classifier import (
+    create_classifier_state,
+    make_classifier_train_step,
+)
 from multidisttorch_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,
@@ -104,6 +110,142 @@ def test_tp_training_matches_data_parallel():
     dp = _train_losses(1)
     tp = _train_losses(4)
     np.testing.assert_allclose(dp, tp, rtol=2e-4)
+
+
+def _conv_vae_losses(model_parallel: int, steps: int = 3) -> list[float]:
+    # Tiny ConvVAE (c=8 → channels 8/16/32, all divisible by mp=4) so the
+    # CPU-device conv stack stays fast; same seeds/data across carvings.
+    make = lambda: ConvVAE(latent_dim=8, base_channels=8)
+    if model_parallel == 1:
+        (g,) = setup_groups(1)
+        shardings = None
+        state = create_train_state(
+            g, make(), optax.adam(1e-3), jax.random.key(0)
+        )
+    else:
+        (g,) = setup_groups(1, model_parallel=model_parallel)
+        model = make()
+        state = create_train_state(
+            g, model, optax.adam(1e-3), jax.random.key(0),
+            param_shardings=conv_tp_shardings(g, model),
+        )
+        shardings = state_shardings(state)
+    step = make_train_step(g, make(), optax.adam(1e-3), shardings=shardings)
+    batch = jax.device_put(
+        jnp.asarray(
+            np.random.default_rng(0)
+            .uniform(0, 1, (16, 32 * 32 * 3))
+            .astype(np.float32)
+        ),
+        g.batch_sharding,
+    )
+    losses = []
+    for i in range(steps):
+        state, m = step(state, batch, jax.random.fold_in(jax.random.key(7), i))
+        losses.append(float(m["loss_sum"]))
+    return losses
+
+
+def test_conv_vae_tp_training_matches_data_parallel():
+    # BASELINE.md config 3's model under TP: a (2 data x 4 model) carve
+    # must optimize identically to pure 8-wide DP.
+    dp = _conv_vae_losses(1)
+    tp = _conv_vae_losses(4)
+    np.testing.assert_allclose(dp, tp, rtol=2e-4)
+
+
+def test_conv_tp_requires_divisible_channels():
+    (g,) = setup_groups(1, model_parallel=4)
+    with pytest.raises(ValueError, match="base_channels"):
+        conv_tp_shardings(g, ConvVAE(base_channels=6))
+
+
+def test_conv_tp_params_are_actually_sharded():
+    (g,) = setup_groups(1, model_parallel=4)
+    model = ConvVAE(latent_dim=8, base_channels=8)
+    state = create_train_state(
+        g, model, optax.adam(1e-3), jax.random.key(0),
+        param_shardings=conv_tp_shardings(g, model),
+    )
+    # enc0 column-parallel: (3,3,3,8) kernel → (3,3,3,2) shards
+    k = state.params["enc0"]["kernel"]
+    assert k.shape == (3, 3, 3, 8)
+    assert k.addressable_shards[0].data.shape == (3, 3, 3, 2)
+    # enc1 row-parallel consumer: (3,3,8,16) → (3,3,2,16) shards
+    k = state.params["enc1"]["kernel"]
+    assert k.addressable_shards[0].data.shape == (3, 3, 2, 16)
+    # Adam moments inherit the sharding (eager init)
+    mu = state.opt_state[0].mu["enc0"]["kernel"]
+    assert mu.addressable_shards[0].data.shape == (3, 3, 3, 2)
+
+
+def _resnet_losses(model_parallel: int, steps: int = 3) -> list[float]:
+    # Two-stage mini ResNet (channels 8/16, one projection shortcut) —
+    # exercises every sharding rule incl. the replicated Conv_2 path.
+    make = lambda: ResNet(
+        num_classes=10, stage_sizes=(1, 1), base_channels=8, image_hw=16
+    )
+    tx = optax.adam(1e-3)
+    if model_parallel == 1:
+        (g,) = setup_groups(1)
+        shardings = None
+        state = create_classifier_state(g, make(), tx, jax.random.key(0))
+    else:
+        (g,) = setup_groups(1, model_parallel=model_parallel)
+        model = make()
+        state = create_classifier_state(
+            g, model, tx, jax.random.key(0),
+            param_shardings=resnet_tp_shardings(g, model),
+        )
+        shardings = state_shardings(state)
+    step = make_classifier_train_step(g, make(), tx, shardings=shardings)
+    rng = np.random.default_rng(0)
+    images = jax.device_put(
+        jnp.asarray(rng.uniform(0, 1, (16, 16 * 16 * 3)).astype(np.float32)),
+        g.batch_sharding,
+    )
+    labels = jax.device_put(
+        jnp.asarray(rng.integers(0, 10, (16,)).astype(np.int32)),
+        g.batch_sharding,
+    )
+    losses = []
+    for _ in range(steps):
+        state, m = step(state, images, labels)
+        losses.append(float(m["loss"]))
+    return losses
+
+
+def test_resnet_tp_training_matches_data_parallel():
+    # BASELINE.md config 4's model under TP on a (4 data x 2 model) carve.
+    dp = _resnet_losses(1)
+    tp = _resnet_losses(2)
+    np.testing.assert_allclose(dp, tp, rtol=2e-4)
+
+
+def test_resnet_tp_shardings_cover_block_structure():
+    (g,) = setup_groups(1, model_parallel=2)
+    model = ResNet(stage_sizes=(1, 1), base_channels=8, image_hw=16)
+    sh = resnet_tp_shardings(g, model)
+    # First block's Megatron pair: col conv (+sharded norm), row conv.
+    blk = sh["BasicBlock_0"]
+    assert blk["Conv_0"]["kernel"].spec == jax.sharding.PartitionSpec(
+        None, None, None, MODEL_AXIS
+    )
+    assert blk["GroupNorm_0"]["scale"].spec == jax.sharding.PartitionSpec(
+        MODEL_AXIS
+    )
+    assert blk["Conv_1"]["kernel"].spec == jax.sharding.PartitionSpec(
+        None, None, MODEL_AXIS, None
+    )
+    assert blk["GroupNorm_1"]["scale"].spec == jax.sharding.PartitionSpec()
+    # Stage-crossing block has a projection shortcut — replicated.
+    assert "Conv_2" in sh["BasicBlock_1"]
+    assert sh["BasicBlock_1"]["Conv_2"]["kernel"].spec == (
+        jax.sharding.PartitionSpec()
+    )
+    # Stem and head stay replicated (layout joins).
+    assert sh["stem"]["kernel"].spec == jax.sharding.PartitionSpec()
+    assert sh["head"]["kernel"].spec == jax.sharding.PartitionSpec()
 
 
 def test_tp_state_layout_is_stable_across_steps():
