@@ -13,6 +13,13 @@ from multidisttorch_tpu.parallel.collectives import (
     group_pmean,
     group_psum,
 )
+from multidisttorch_tpu.parallel.pipeline import (
+    pack_stage_params,
+    pipeline_apply,
+    pipeline_apply_stages,
+    stage_params_sharding,
+    unpack_stage_params,
+)
 from multidisttorch_tpu.parallel.mesh import (
     DATA_AXIS,
     MODEL_AXIS,

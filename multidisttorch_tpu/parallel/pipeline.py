@@ -24,8 +24,9 @@ gradients over both axes.
 
 from __future__ import annotations
 
+import math
 from functools import partial
-from typing import Any, Callable
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -194,4 +195,253 @@ def sequential_reference(stage_fn, stage_params, batch):
     num_stages = jax.tree.leaves(stage_params)[0].shape[0]
     for s in range(num_stages):
         x = stage_fn(jax.tree.map(lambda p: p[s], stage_params), x)
+    return x
+
+
+# --- shape-heterogeneous stages (real models) -------------------------------
+#
+# :func:`pipeline_apply` requires equal-width stages — fine for scan-over-
+# layers transformer stacks, useless for the models this repo actually
+# ships (a ResNet halves its spatial dims while doubling channels; a
+# ConvVAE narrows to a latent bottleneck). The general SPMD form below
+# lifts the restriction with two devices-run-one-program tricks:
+#
+# - **padded flat carry**: every activation travels between stages as a
+#   ``(microbatch, A)`` float32 buffer, ``A`` = the widest per-sample
+#   activation in the chain; each stage unpads/reshapes its true input
+#   and re-pads its output. The ppermute stays well-typed because every
+#   hop has the one static shape.
+# - **lax.switch on the stage index**: stage bodies differ, but SPMD
+#   needs one program — each device selects its own stage's branch with
+#   its pipe-axis coordinate. Branch s statically unpacks stage s's
+#   params from the packed row and runs its compute; control flow is a
+#   device-local scalar conditional, so no collective may appear inside
+#   a stage body (document-level contract, same as GPipe kernels).
+# - **packed params**: per-stage param pytrees (different structures!)
+#   flatten+concat+pad into one ``(S, Pmax)`` float32 array sharded over
+#   ``pipe`` — each device physically holds only its own stage's row,
+#   which is the memory point of pipeline parallelism. The optimizer
+#   runs directly on the packed array (Adam is elementwise), so the
+#   sharding survives training with zero extra machinery.
+
+
+def pack_stage_params(stage_trees: Sequence[Any]) -> tuple[jax.Array, tuple]:
+    """Pack per-stage param pytrees into one ``(S, Pmax)`` float32 array.
+
+    Returns ``(packed, metas)``; place ``packed`` with
+    :func:`stage_params_sharding` so each pipe device owns its row.
+    ``metas`` is static unpack metadata for :func:`unpack_stage_params`
+    and :func:`pipeline_apply_stages`.
+    """
+    metas, rows = [], []
+    for tree in stage_trees:
+        leaves, treedef = jax.tree.flatten(tree)
+        for leaf in leaves:
+            if leaf.dtype != jnp.float32:
+                raise ValueError(
+                    f"packed stage params must be float32, got {leaf.dtype} "
+                    "(keep param_dtype=float32; compute dtype is the "
+                    "stage_fn's business)"
+                )
+        metas.append((treedef, tuple(tuple(l.shape) for l in leaves)))
+        rows.append(
+            jnp.concatenate([jnp.ravel(l) for l in leaves])
+            if leaves
+            else jnp.zeros((0,), jnp.float32)
+        )
+    pmax = max((int(r.shape[0]) for r in rows), default=0)
+    packed = jnp.stack([jnp.pad(r, (0, pmax - r.shape[0])) for r in rows])
+    return packed, tuple(metas)
+
+
+def unpack_stage_params(row: jax.Array, meta) -> Any:
+    """Rebuild one stage's param pytree from its packed row (static
+    slicing — safe inside a ``lax.switch`` branch)."""
+
+    treedef, shapes = meta
+    leaves, off = [], 0
+    for shape in shapes:
+        size = math.prod(shape)
+        leaves.append(row[off : off + size].reshape(shape))
+        off += size
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def _pipeline_stages_local(
+    packed_params,
+    batch,
+    *,
+    stage_fns,
+    metas,
+    in_shapes,
+    out_shape,
+    width,
+    num_stages,
+    num_microbatches,
+    pipe_axis,
+    vary_axes,
+):
+    """Per-device body for heterogeneous stages (see module comment)."""
+    from multidisttorch_tpu.parallel.collectives import pvary
+
+    my_row = packed_params[0]  # this device's stage row, (Pmax,)
+    stage_id = jax.lax.axis_index(pipe_axis)
+    is_first = stage_id == 0
+    is_last = stage_id == num_stages - 1
+
+    n = batch.shape[0]
+    mb = n // num_microbatches
+    micro = batch.reshape((num_microbatches, mb) + batch.shape[1:])
+
+    def flat_pad(x):
+        f = x.reshape(x.shape[0], -1).astype(jnp.float32)
+        return jnp.pad(f, ((0, 0), (0, width - f.shape[1])))
+
+    def make_branch(s):
+
+        in_size = math.prod(in_shapes[s])
+
+        def branch(row, buf):
+            p = unpack_stage_params(row, metas[s])
+            a = buf[:, :in_size].reshape((mb,) + in_shapes[s])
+            return flat_pad(stage_fns[s](p, a))
+
+        return branch
+
+    branches = [make_branch(s) for s in range(num_stages)]
+
+    state0 = pvary(jnp.zeros((mb, width), jnp.float32), vary_axes)
+    out0 = pvary(
+        jnp.zeros((num_microbatches, mb, width), jnp.float32), vary_axes
+    )
+    shift = [(i, i + 1) for i in range(num_stages - 1)]
+
+    def tick(carry, t):
+        state, outs = carry
+        inj = flat_pad(micro[jnp.clip(t, 0, num_microbatches - 1)])
+        x = jnp.where(is_first, inj, state)
+        y = jax.lax.switch(stage_id, branches, my_row, x)
+        out_idx = t - (num_stages - 1)
+        valid = jnp.logical_and(is_last, out_idx >= 0)
+        slot = jnp.clip(out_idx, 0, num_microbatches - 1)
+        prev = jax.lax.dynamic_index_in_dim(outs, slot, keepdims=False)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, jnp.where(valid, y, prev), slot, axis=0
+        )
+        state = jax.lax.ppermute(y, pipe_axis, shift)
+        return (state, outs), None
+
+    ticks = jnp.arange(num_microbatches + num_stages - 1)
+    (_, outs), _ = jax.lax.scan(tick, (state0, out0), ticks)
+
+    outs = jax.lax.psum(
+        jnp.where(is_last, outs, jnp.zeros_like(outs)), pipe_axis
+    )
+
+    out_size = math.prod(out_shape)
+    return outs[:, :, :out_size].reshape((n,) + out_shape)
+
+
+def pipeline_apply_stages(
+    trial: TrialMesh | Mesh,
+    stage_fns: Sequence[Callable[[Any, jax.Array], jax.Array]],
+    stage_params: Sequence[Any],
+    *,
+    num_microbatches: int,
+) -> tuple[Callable[[Any, jax.Array], jax.Array], jax.Array]:
+    """GPipe for **shape-heterogeneous** stages — real models.
+
+    - ``stage_fns[s](params_s, x) -> y``: per-stage compute; input/output
+      shapes may differ per stage (a conv stage may halve spatial dims,
+      the last stage may emit class logits). Stage bodies must be
+      collective-free (each device executes only its own branch).
+    - ``stage_params[s]``: stage s's param pytree (float32 leaves;
+      structures may differ per stage).
+
+    Returns ``(apply, packed)``: place ``packed`` with
+    :func:`stage_params_sharding`, then ``apply(packed, batch) -> out``
+    is pure and differentiable — grad w.r.t. ``packed`` keeps the
+    per-stage sharding, and an elementwise optimizer (Adam) applied to
+    the packed array trains the pipeline directly. On a ``(data, pipe)``
+    submesh GSPMD additionally reduces gradients over ``data``: DP x PP
+    from one jitted program.
+    """
+
+    mesh = _resolve_mesh(trial)
+    if PIPE_AXIS not in mesh.shape:
+        raise ValueError(
+            f"mesh has no '{PIPE_AXIS}' axis (axes: {tuple(mesh.shape)}); "
+            "carve one with setup_groups(..., pipeline_parallel=S)"
+        )
+    num_stages = int(mesh.shape[PIPE_AXIS])
+    if len(stage_fns) != num_stages or len(stage_params) != num_stages:
+        raise ValueError(
+            f"{len(stage_fns)} stage_fns / {len(stage_params)} stage_params "
+            f"for a pipe axis of extent {num_stages}"
+        )
+    if num_microbatches < 1:
+        raise ValueError(
+            f"num_microbatches must be >= 1, got {num_microbatches}"
+        )
+    has_data = DATA_AXIS in mesh.shape
+    data_size = int(mesh.shape[DATA_AXIS]) if has_data else 1
+    batch_spec = P(DATA_AXIS) if has_data else P()
+
+    packed, metas = pack_stage_params(stage_params)
+    param_avals = [
+        jax.tree.map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), tree
+        )
+        for tree in stage_params
+    ]
+
+    def apply(packed_arr, batch):
+        shard_n, rem = divmod(batch.shape[0], data_size)
+        if rem or shard_n % num_microbatches:
+            raise ValueError(
+                f"batch leading axis {batch.shape[0]} must divide into "
+                f"{data_size} data shard(s) x {num_microbatches} "
+                "microbatches of equal size"
+            )
+        mb = shard_n // num_microbatches
+        # Probe the stage shape chain abstractly (no FLOPs): stage s's
+        # output shape is stage s+1's input shape.
+        in_shapes = [tuple(batch.shape[1:])]
+        for s in range(num_stages):
+            out_aval = jax.eval_shape(
+                stage_fns[s],
+                param_avals[s],
+                jax.ShapeDtypeStruct((mb,) + in_shapes[s], jnp.float32),
+            )
+            in_shapes.append(tuple(out_aval.shape[1:]))
+        width = max(math.prod(s) for s in in_shapes)
+
+        return jax.shard_map(
+            partial(
+                _pipeline_stages_local,
+                stage_fns=tuple(stage_fns),
+                metas=metas,
+                in_shapes=tuple(in_shapes[:num_stages]),
+                out_shape=in_shapes[num_stages],
+                width=width,
+                num_stages=num_stages,
+                num_microbatches=num_microbatches,
+                pipe_axis=PIPE_AXIS,
+                vary_axes=(
+                    ((DATA_AXIS,) if has_data else ()) + (PIPE_AXIS,)
+                ),
+            ),
+            mesh=mesh,
+            in_specs=(P(PIPE_AXIS), batch_spec),
+            out_specs=batch_spec,
+        )(packed_arr, batch)
+
+    return apply, packed
+
+
+def sequential_stages_reference(stage_fns, stage_params, batch):
+    """Single-device reference for heterogeneous stages (for tests)."""
+    x = batch
+    for fn, p in zip(stage_fns, stage_params):
+        x = fn(p, x)
     return x
