@@ -40,6 +40,27 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# VMEM working-set budget for one grid step (both passes keep ≤4 operand
+# blocks + ≤3 output blocks resident; v5e VMEM is 128MB/core but small
+# blocks pipeline better and leave room for XLA's own buffers). Module
+# constant so tests can shrink it to force multi-block grids.
+_VMEM_BUDGET_BYTES = 4 * 2**20
+
+
+def _block_rows(batch: int, d: int, latent: int) -> int:
+    """Largest divisor of ``batch`` whose 7-buffer working set fits the
+    VMEM budget (whole rows only: the feature dims stay unsplit, so the
+    reduction needs no cross-column accumulator)."""
+    per_row = 4 * (4 * d + 3 * latent)  # f32: l,x,dl dL blocks + mu/lv/dmu/dlv
+    target = max(1, _VMEM_BUDGET_BYTES // per_row)
+    if batch <= target:
+        return batch
+    for bb in range(target, 0, -1):
+        if batch % bb == 0:
+            return bb
+    return batch  # unreachable (bb=1 always divides)
+
+
 def _fwd_kernel(logits_ref, x_ref, mu_ref, logvar_ref, out_ref, *, beta):
     l = logits_ref[:]
     x = x_ref[:]
@@ -50,7 +71,17 @@ def _fwd_kernel(logits_ref, x_ref, mu_ref, logvar_ref, out_ref, *, beta):
     mu = mu_ref[:]
     logvar = logvar_ref[:]
     kl = -0.5 * jnp.sum(1.0 + logvar - mu * mu - jnp.exp(logvar))
-    out_ref[0, 0] = bce + beta * kl
+    part = bce + beta * kl
+
+    # Scalar accumulation across the (sequential) batch-block grid: the
+    # SMEM output block is the same (0,0) cell every step.
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[0, 0] = part
+
+    @pl.when(pl.program_id(0) > 0)
+    def _acc():
+        out_ref[0, 0] = out_ref[0, 0] + part
 
 
 def _bwd_kernel(logits_ref, x_ref, mu_ref, logvar_ref,
@@ -73,11 +104,22 @@ def fused_elbo_loss_sum(logits, x, mu, logvar, beta=1.0):
 
 
 def _fwd(logits, x, mu, logvar, beta):
+    b, d = logits.shape
+    lat = mu.shape[1]
+    bb = _block_rows(b, d, lat)
     out = pl.pallas_call(
         partial(_fwd_kernel, beta=beta),
+        grid=(b // bb,),
         out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 4,
-        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        in_specs=[
+            pl.BlockSpec((bb, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, lat), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((bb, lat), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM
+        ),
         interpret=_interpret(),
     )(logits, x, mu, logvar)
     return out[0, 0], (logits, x, mu, logvar)
@@ -85,19 +127,25 @@ def _fwd(logits, x, mu, logvar, beta):
 
 def _bwd(beta, residuals, g):
     logits, x, mu, logvar = residuals
+    b, d = logits.shape
+    lat = mu.shape[1]
+    bb = _block_rows(b, d, lat)
+    wide = lambda: pl.BlockSpec(
+        (bb, d), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
+    narrow = lambda: pl.BlockSpec(
+        (bb, lat), lambda i: (i, 0), memory_space=pltpu.VMEM
+    )
     dlogits, dmu, dlogvar = pl.pallas_call(
         partial(_bwd_kernel, beta=beta),
+        grid=(b // bb,),
         out_shape=(
             jax.ShapeDtypeStruct(logits.shape, jnp.float32),
             jax.ShapeDtypeStruct(mu.shape, jnp.float32),
             jax.ShapeDtypeStruct(logvar.shape, jnp.float32),
         ),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 4,
-        out_specs=(
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.VMEM),
-        ),
+        in_specs=[wide(), wide(), narrow(), narrow()],
+        out_specs=(wide(), narrow(), narrow()),
         interpret=_interpret(),
     )(logits, x, mu, logvar)
     # x is data: propagate its true cotangent (-logits * g) for

@@ -49,6 +49,47 @@ def test_gradient_parity(arrays):
         )
 
 
+def test_multi_block_grid_matches_plain(monkeypatch):
+    # VERDICT r3 item 5: the kernel must tile over batch blocks instead
+    # of staging whole operands in VMEM. Shrink the budget so a modest
+    # batch needs a multi-step grid, and check value+grad parity through
+    # the SMEM scalar accumulation across grid steps.
+    from multidisttorch_tpu.ops import pallas_elbo
+
+    monkeypatch.setattr(pallas_elbo, "_VMEM_BUDGET_BYTES", 64 * 1024)
+    rng = np.random.default_rng(7)
+    b, d, lat = 96, 784, 20
+    assert pallas_elbo._block_rows(b, d, lat) < b  # grid really > 1
+    logits = jnp.asarray(rng.normal(0, 2, (b, d)).astype(np.float32))
+    x = jnp.asarray(rng.uniform(0, 1, (b, d)).astype(np.float32))
+    mu = jnp.asarray(rng.normal(0, 1, (b, lat)).astype(np.float32))
+    logvar = jnp.asarray(rng.normal(0, 0.5, (b, lat)).astype(np.float32))
+
+    fused = float(fused_elbo_loss_sum(logits, x, mu, logvar, 1.5))
+    plain = float(elbo_loss_sum(logits, x, mu, logvar, 1.5))
+    assert fused == pytest.approx(plain, rel=1e-5)
+
+    g_fused = jax.grad(
+        lambda l, m, lv: fused_elbo_loss_sum(l, x, m, lv, 1.5),
+        argnums=(0, 1, 2),
+    )(logits, mu, logvar)
+    g_plain = jax.grad(
+        lambda l, m, lv: elbo_loss_sum(l, x, m, lv, 1.5), argnums=(0, 1, 2)
+    )(logits, mu, logvar)
+    for a, b_ in zip(g_fused, g_plain):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_block_rows_divides_batch():
+    from multidisttorch_tpu.ops.pallas_elbo import _block_rows
+
+    for batch in (1, 7, 96, 128, 10000):
+        bb = _block_rows(batch, 784, 20)
+        assert 1 <= bb <= batch and batch % bb == 0
+
+
 def test_works_under_jit_and_scaling(arrays):
     logits, x, mu, logvar = arrays
 
