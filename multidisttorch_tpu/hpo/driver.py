@@ -86,6 +86,13 @@ class TrialConfig:
     # of folded per step), so it participates in the resume
     # config-match check like any other hyperparameter.
     fused_steps: int = 1
+    # Reference-parity eval semantics: the reference's test() runs the
+    # full sampled forward (z drawn from the posterior —
+    # /root/reference/vae-hpo.py:101-105 calling model(data), :42-45).
+    # Default False = posterior-mean eval (deterministic, strictly
+    # tighter bound); True reproduces the reference's sampled test-loss
+    # metric for apples-to-apples quality comparison.
+    eval_sampled: bool = False
 
 
 @dataclass
@@ -109,6 +116,10 @@ class TrialResult:
     # recorded metrics must say which world they came from.
     dataset: str = ""
     dataset_synthetic: bool = False
+    # Host↔device round-trips the trial actually paid for metric
+    # fetches (the O(1)-syncs discipline: ≤ log lines + 2 per epoch;
+    # regression-tested in tests/test_hpo.py).
+    host_syncs: int = 0
 
 
 class _TrialRun:
@@ -180,6 +191,7 @@ class _TrialRun:
         # while peers keep stepping it.
         self._agree = agree_failures
         self._deferred_error: Optional[BaseException] = None
+        self._host_syncs = 0
 
         if model_builder is None:
             model = VAE(hidden_dim=cfg.hidden_dim, latent_dim=cfg.latent_dim)
@@ -201,7 +213,12 @@ class _TrialRun:
         # save_images argument, NOT the per-process writer-gated flag:
         # all owner processes must compile the identical eval program.
         self.eval_step = make_eval_step(
-            trial, model, beta=cfg.beta, with_recon=save_images, masked=True
+            trial,
+            model,
+            beta=cfg.beta,
+            with_recon=save_images,
+            masked=True,
+            sampled=cfg.eval_sampled,
         )
         self.sample_step = make_sample_step(trial, model)
         self.train_iter = TrialDataIterator(
@@ -379,13 +396,18 @@ class _TrialRun:
         # resume-safe global step for RNG folding.
         step_no = int(jax.device_get(self.state.step))
         for epoch in range(self._start_epoch, cfg.epochs + 1):
-            epoch_loss_sums = []
+            # On-device loss accumulation (mirrors the eval path below):
+            # each batch's contribution is an async device add; the
+            # single float() at the epoch boundary is the train loop's
+            # only non-logging host sync.
+            epoch_sum_dev = None
 
             def log_batch(epoch, i, loss_sum):
                 if not self._verbose:
                     return  # don't pay the device sync for a dropped line
                 # sync point for THIS trial only (reference logs
                 # loss.item() here, vae-hpo.py:76-86)
+                self._host_syncs += 1
                 per_sample = float(loss_sum) / cfg.batch_size
                 self._log(
                     "Train Epoch: {} [{}/{} ({:.0f}%)]\tLoss: {:.6f}".format(
@@ -404,7 +426,8 @@ class _TrialRun:
                         self.state, batch, rng
                     )
                     step_no += 1
-                    epoch_loss_sums.append(metrics["loss_sum"])  # on device
+                    s = metrics["loss_sum"]  # on device, async
+                    epoch_sum_dev = s if epoch_sum_dev is None else epoch_sum_dev + s
                     if i % cfg.log_interval == 0:
                         log_batch(epoch, i, metrics["loss_sum"])
                     yield  # hand the host loop to the next trial
@@ -424,7 +447,10 @@ class _TrialRun:
                         )
                         step_no += c
                         losses = metrics["loss_sum"]  # (K,) on device
-                        epoch_loss_sums.append(losses)
+                        s = losses.sum()  # device add, async
+                        epoch_sum_dev = (
+                            s if epoch_sum_dev is None else epoch_sum_dev + s
+                        )
                         # Every batch index that would have logged in the
                         # per-step loop still logs (there can be several
                         # per chunk when log_interval < fused_steps).
@@ -441,14 +467,19 @@ class _TrialRun:
                                 self.state, chunk[j], rng
                             )
                             step_no += 1
-                            epoch_loss_sums.append(metrics["loss_sum"])
+                            s = metrics["loss_sum"]
+                            epoch_sum_dev = (
+                                s
+                                if epoch_sum_dev is None
+                                else epoch_sum_dev + s
+                            )
                             if (i0 + j) % cfg.log_interval == 0:
                                 log_batch(epoch, i0 + j, metrics["loss_sum"])
                     yield
 
-            avg = float(
-                np.sum([np.sum(np.asarray(s)) for s in epoch_loss_sums])
-            ) / n_per_epoch
+            # One fetch for the whole epoch's average (O(1)-syncs rule).
+            self._host_syncs += 1
+            avg = float(epoch_sum_dev) / n_per_epoch
             self._log(
                 "====> Epoch: {} Average loss: {:.4f}".format(epoch, avg)
             )
@@ -463,7 +494,17 @@ class _TrialRun:
                 for j, (tbatch, tweights) in enumerate(
                     self.test_iter.batches()
                 ):
-                    out = self.eval_step(self.state, tbatch, tweights)
+                    if cfg.eval_sampled:
+                        # Distinct key per (epoch, batch), disjoint from
+                        # the train stream (offset past any step count).
+                        erng = jax.random.fold_in(
+                            self._key, 2**28 + epoch * 2**16 + j
+                        )
+                        out = self.eval_step(
+                            self.state, tbatch, tweights, erng
+                        )
+                    else:
+                        out = self.eval_step(self.state, tbatch, tweights)
                     test_sum_dev = (
                         out["loss_sum"]
                         if test_sum_dev is None
@@ -483,6 +524,7 @@ class _TrialRun:
                     yield
                 # Exact-count divisor: every real row was evaluated, the
                 # padded rows carried weight 0.0.
+                self._host_syncs += 1
                 test_avg = float(test_sum_dev) / self.test_iter.num_rows
                 self._log("====> Test set loss: {:.4f}".format(test_avg))
                 epoch_record["test_loss"] = test_avg
@@ -566,6 +608,7 @@ class _TrialRun:
             self._join_ckpt()
         self.result.wall_s = time.time() - t0
         self.result.steps = step_no
+        self.result.host_syncs = self._host_syncs
         if self._is_writer:
             with self._guard():
                 os.makedirs(self.out_dir, exist_ok=True)
