@@ -14,8 +14,10 @@ import json
 import os
 import sys
 
+_DEVS_PER_PROC = int(os.environ.get("MH_DEVS_PER_PROC", "4"))
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={_DEVS_PER_PROC}"
 )
 os.environ["JAX_PLATFORMS"] = "cpu"
 
@@ -33,8 +35,9 @@ def main():
     from multidisttorch_tpu.data.datasets import synthetic_mnist
 
     nproc, pid = mdt.initialize_runtime()
-    assert nproc == 2, f"expected 2 processes, got {nproc}"
-    assert len(jax.devices()) == 8, jax.devices()
+    want_procs = int(os.environ["OMPI_COMM_WORLD_SIZE"])
+    assert nproc == want_procs, f"expected {want_procs} processes, got {nproc}"
+    assert len(jax.devices()) == nproc * _DEVS_PER_PROC, jax.devices()
 
     train = synthetic_mnist(128, seed=0)
     test = synthetic_mnist(32, seed=1)
@@ -178,6 +181,38 @@ def main():
             "pid": pid,
             "statuses": {r.trial_id: r.status for r in results},
             "errors": {r.trial_id: (r.error or "")[:120] for r in results},
+        }
+
+    elif mode == "hpo_uneven":
+        # UNEVEN OWNERSHIP: carve two 3-device groups out of the first 6
+        # devices of a (4 proc x 2 dev) world. Group 0 = devices 0-2
+        # (procs 0+1 own 2/1 devices), group 1 = devices 3-5 (procs 1+2
+        # own 1/2) — both spanning submeshes with ASYMMETRIC device
+        # counts per owner; proc 3 owns nothing and must finish cleanly
+        # (the reference orphan-rank scenario, quirk Q5, minus the hang).
+        from multidisttorch_tpu.hpo.driver import TrialConfig, run_hpo
+        from multidisttorch_tpu.parallel.mesh import setup_groups
+
+        groups = setup_groups(2, devices=jax.devices()[:6])
+        configs = [
+            TrialConfig(t, epochs=1, batch_size=12, hidden_dim=16,
+                        latent_dim=4, seed=t)
+            for t in range(2)
+        ]
+        results = run_hpo(
+            configs, train, test, groups=groups, out_dir=out_dir,
+            verbose=False, save_images=False, save_checkpoints=True,
+        )
+        summary = {
+            "pid": pid,
+            "local_trials": [r.trial_id for r in results],
+            "losses": {
+                r.trial_id: round(r.final_train_loss, 6) for r in results
+            },
+            "steps": {r.trial_id: r.steps for r in results},
+            "wrote_ckpt": {
+                r.trial_id: bool(r.checkpoint) for r in results
+            },
         }
 
     elif mode == "pbt":

