@@ -1,10 +1,11 @@
 """Worker program for the multi-controller integration tests.
 
-Launched as 2 cooperating processes by ``test_multihost.py`` (4 virtual
-CPU devices each → an 8-device, 2-process world). Bring-up goes through
-the framework's own launcher-env path: the parent sets
-``OMPI_COMM_WORLD_SIZE/RANK`` + ``MASTER_ADDR/PORT`` (the reference's
-Summit-style environment, ``/root/reference/utils.py:13-16,108-109``)
+Launched as N cooperating processes by ``test_multihost.py`` (M virtual
+CPU devices each, both set by the parent — 2x4 and 4x2 worlds today).
+Bring-up goes through the framework's own launcher-env path: the parent
+sets ``OMPI_COMM_WORLD_SIZE/RANK`` + ``MASTER_ADDR/PORT`` (the
+reference's Summit-style environment,
+``/root/reference/utils.py:13-16,108-109``) plus ``MH_DEVS_PER_PROC``,
 and ``initialize_runtime`` does the rest.
 
 Each mode prints one ``RESULT {json}`` line the parent asserts on.

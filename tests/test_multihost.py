@@ -1,6 +1,7 @@
-"""True multi-controller integration tests: 2 cooperating processes,
-4 virtual CPU devices each (8-device world over the Gloo-backed JAX
-distributed runtime).
+"""True multi-controller integration tests: N cooperating processes with
+M virtual CPU devices each over the Gloo-backed JAX distributed runtime
+(2x4 for the core cases, 4x2 for the >2-process agreement/writer-gating
+and uneven-ownership cases).
 
 The reference could only validate multi-node behavior by running on the
 real clusters its env detection targets (SURVEY.md §4); these tests
