@@ -23,9 +23,9 @@ the one it belongs to, and runs a DDP trial whose only hyperparameter is
 - **Elastic scheduling**: more configs than submeshes is legal — the
   reference hard-binds one trial per group forever (``vae-hpo.py:
   200-202``); here freed submeshes immediately pick up the next queued
-  config (greedy single-controller; deterministic round-robin
-  assignment multi-controller, where every process must schedule
-  identically without communicating).
+  config (greedy single-controller; deterministic least-predicted-load
+  assignment multi-controller — :func:`balanced_assignment` — where
+  every process must schedule identically without communicating).
 - **Failure isolation** (``resilient=True``): one trial's exception
   marks that trial failed and frees its submesh; the rest of the sweep
   proceeds. The reference has no failure handling at all — a dead rank
@@ -657,9 +657,10 @@ def run_hpo(
     **More configs than groups is legal**: excess configs queue, and a
     submesh picks up its next trial the moment its current one finishes
     (greedy in single-controller mode; in multi-controller SPMD the
-    assignment is the deterministic round-robin ``config i → group
-    i % G``, because every process must make identical scheduling
-    decisions without communicating). Trials whose submesh has no local
+    assignment is the deterministic least-predicted-load schedule of
+    :func:`balanced_assignment` — every process must make identical
+    scheduling decisions without communicating, and trial durations are
+    predictable from the configs). Trials whose submesh has no local
     devices are skipped on this process (multi-controller membership,
     ``vae-hpo.py:200-202``).
 
@@ -712,6 +713,40 @@ def run_hpo(
             resilient=resilient,
             resume=resume,
         )
+
+
+def predicted_cost(cfg: TrialConfig, train_rows: int) -> int:
+    """Relative duration estimate for one trial: optimizer steps to run.
+
+    ``epochs`` is the reference's only duration knob (``vae-hpo.py:202``)
+    and ``batch_size`` sets steps per epoch; both are known to every
+    process before any trial starts, which is what lets the
+    multi-controller scheduler balance load without communicating.
+    """
+    steps_per_epoch = max(1, train_rows // max(1, cfg.batch_size))
+    return cfg.epochs * steps_per_epoch
+
+
+def balanced_assignment(costs: Sequence[int], num_groups: int) -> list[int]:
+    """Deterministic least-loaded assignment: config i → the group whose
+    accumulated predicted cost is smallest (ties → lowest group index).
+
+    Pure function of (costs, num_groups), so every process computes the
+    identical schedule — the same no-communication constraint that
+    forced the previous static round-robin. Least-loaded usually beats
+    round-robin when epoch counts differ (costs [4,1,1,1] over 2 groups:
+    round-robin loads (5,2), this gives (4,3)) but, like any online
+    greedy rule, is not universally optimal (costs [2,1,1,2] favor
+    round-robin); it never needs cost information round-robin lacks, and
+    both are deterministic.
+    """
+    loads = [0] * num_groups
+    out = []
+    for c in costs:
+        g = min(range(num_groups), key=lambda j: (loads[j], j))
+        loads[g] += c
+        out.append(g)
+    return out
 
 
 def _run_hpo_body(
@@ -785,15 +820,27 @@ def _run_hpo_body(
     # Queue configs per group. Single-controller: one shared queue,
     # greedy — whichever submesh frees first takes the next config
     # (optimal when trials have unequal epoch counts). Multi-controller:
-    # static round-robin so all processes agree on every assignment.
+    # every process must make identical assignments WITHOUT
+    # communicating, so the schedule is computed deterministically from
+    # shared state (the configs themselves): each config goes to the
+    # group with the least accumulated predicted cost (epochs x steps
+    # per epoch — the knobs that set trial duration, vae-hpo.py:202).
+    # Typically better than round-robin under unequal epoch counts
+    # (queues are sized to their trials' predicted lengths up front; see
+    # balanced_assignment's docstring for the caveat) while remaining
+    # process-independent.
     single = jax.process_count() == 1
     shared: list[tuple[int, TrialConfig]] = list(enumerate(configs))
     per_group: dict[int, list[tuple[int, TrialConfig]]] = {
         g.group_id: [] for g in groups
     }
     if not single:
+        assignment = balanced_assignment(
+            [predicted_cost(cfg, len(train_data)) for cfg in configs],
+            len(groups),
+        )
         for i, cfg in enumerate(configs):
-            per_group[groups[i % len(groups)].group_id].append((i, cfg))
+            per_group[groups[assignment[i]].group_id].append((i, cfg))
     queue_of = (
         (lambda g: shared) if single else (lambda g: per_group[g.group_id])
     )
