@@ -1,0 +1,121 @@
+"""Mixture-of-experts MLP with expert parallelism (GShard-style).
+
+The reference has no MoE or expert parallelism (SURVEY.md §2c). This is
+the TPU-native formulation: routing is expressed as STATIC one-hot
+dispatch/combine einsums (no gather/scatter, no dynamic shapes — the
+GShard/Switch recipe), so the whole block jits into a handful of
+MXU-friendly contractions. Expert parallelism is then nothing but a
+sharding: every expert-indexed parameter carries a leading ``(E, ...)``
+axis annotated over the submesh's ``model`` axis
+(:func:`moe_ep_shardings`), and GSPMD partitions the dispatch/compute/
+combine einsums so each device runs only its experts, inserting the
+all-to-all-equivalent collectives itself.
+
+Top-1 routing with a capacity limit: each expert serves at most
+``C = ceil(tokens/E * capacity_factor)`` tokens per batch; overflow
+tokens pass through with zero contribution (standard Switch behavior).
+The auxiliary load-balancing loss (Switch eq. 4) is returned alongside
+the output so training can keep the router from collapsing.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEMLP(nn.Module):
+    """Top-1-routed expert MLP: ``(B, d_in) -> (B, d_out)``.
+
+    Parameters carry a leading expert axis — ``gate`` is a plain dense
+    router, ``w1/b1/w2/b2`` are per-expert two-layer MLP weights.
+    """
+
+    num_experts: int
+    hidden_dim: int
+    out_dim: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+        b, d = x.shape
+        e, h, o = self.num_experts, self.hidden_dim, self.out_dim
+        cap = max(1, math.ceil(b * self.capacity_factor / e))
+        x = x.astype(self.dtype)
+
+        init = nn.initializers.lecun_normal()
+        w1 = self.param("w1", init, (e, d, h), jnp.float32).astype(self.dtype)
+        b1 = self.param(
+            "b1", nn.initializers.zeros, (e, h), jnp.float32
+        ).astype(self.dtype)
+        w2 = self.param("w2", init, (e, h, o), jnp.float32).astype(self.dtype)
+        b2 = self.param(
+            "b2", nn.initializers.zeros, (e, o), jnp.float32
+        ).astype(self.dtype)
+
+        gates = jax.nn.softmax(
+            nn.Dense(e, dtype=jnp.float32, param_dtype=jnp.float32,
+                     name="gate")(x.astype(jnp.float32)),
+            axis=-1,
+        )  # (B, E) — router math in f32 for stable argmax/softmax
+        expert_idx = jnp.argmax(gates, axis=-1)  # (B,)
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)  # (B, E)
+        top_gate = jnp.sum(gates * onehot, axis=-1)  # (B,)
+
+        # Queue position of each token within its chosen expert; tokens
+        # past capacity are dropped (zero dispatch -> zero output).
+        pos = jnp.cumsum(onehot, axis=0) * onehot  # (B, E), 1-based
+        within = (pos > 0) & (pos <= cap)
+        disp = jax.nn.one_hot(
+            (pos - 1.0).astype(jnp.int32), cap, dtype=jnp.float32
+        ) * within[..., None].astype(jnp.float32)  # (B, E, C)
+
+        expert_in = jnp.einsum(
+            "bec,bd->ecd", disp.astype(self.dtype), x
+        )  # (E, C, d)
+        hmid = jax.nn.relu(
+            jnp.einsum("ecd,edh->ech", expert_in, w1) + b1[:, None, :]
+        )
+        out_e = jnp.einsum("ech,eho->eco", hmid, w2) + b2[:, None, :]
+
+        combine = disp * top_gate[:, None, None]  # (B, E, C)
+        y = jnp.einsum("bec,eco->bo", combine.astype(self.dtype), out_e)
+
+        # Switch aux loss: E * sum_e (fraction routed to e) * (mean gate
+        # prob of e) — minimized at uniform routing.
+        frac = jnp.mean(onehot, axis=0)
+        prob = jnp.mean(gates, axis=0)
+        aux = e * jnp.sum(frac * prob)
+        return y, aux.astype(jnp.float32)
+
+
+def moe_ep_shardings(trial, params: Any) -> Any:
+    """Expert-parallel shardings for a :class:`MoEMLP` param tree: every
+    expert-indexed leaf (leading axis ``num_experts``) splits over the
+    submesh's ``model`` axis; the router stays replicated. GSPMD then
+    partitions the dispatch/compute/combine einsums per expert shard.
+
+    Requires ``num_experts % trial.model_size == 0``.
+    """
+    from multidisttorch_tpu.parallel.mesh import MODEL_AXIS
+
+    m = trial.model_size
+    repl = trial.sharding()
+
+    def rule(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name in ("w1", "b1", "w2", "b2"):
+            if leaf.shape[0] % m:
+                raise ValueError(
+                    f"num_experts={leaf.shape[0]} not divisible by the "
+                    f"model axis ({m})"
+                )
+            return trial.sharding(MODEL_AXIS, *([None] * (leaf.ndim - 1)))
+        return repl
+
+    return jax.tree_util.tree_map_with_path(rule, params)

@@ -13,6 +13,7 @@ from multidisttorch_tpu.parallel.collectives import (
     group_pmean,
     group_psum,
 )
+from multidisttorch_tpu.parallel.fsdp import fsdp_param_shardings
 from multidisttorch_tpu.parallel.pipeline import (
     pack_stage_params,
     pipeline_apply,

@@ -1,0 +1,93 @@
+"""ZeRO/FSDP-style param+optimizer sharding over the data axis
+(parallel/fsdp.py) — absent from the reference (SURVEY.md §2c), nearly
+free via GSPMD. Runs on 8 virtual CPU devices (tests/conftest.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from multidisttorch_tpu.models.vae import VAE
+from multidisttorch_tpu.parallel.fsdp import fsdp_param_shardings
+from multidisttorch_tpu.parallel.mesh import DATA_AXIS, setup_groups
+from multidisttorch_tpu.train.steps import (
+    create_train_state,
+    make_train_step,
+    state_shardings,
+)
+
+from jax.sharding import PartitionSpec as P
+
+
+def test_sharding_rule_splits_large_leaves_only():
+    (g,) = setup_groups(1)  # 8-wide data axis
+    model = VAE(hidden_dim=32, latent_dim=8)
+    params = model.init(
+        {"params": jax.random.key(0), "reparam": jax.random.key(0)},
+        jnp.zeros((1, 784), jnp.float32),
+    )["params"]
+    sh = fsdp_param_shardings(g, params)
+    # (784, 32) kernel: largest divisible axis (784) sharded
+    assert sh["fc1"]["kernel"].spec == P(DATA_AXIS, None)
+    # (32,) bias: under min_size -> replicated
+    assert sh["fc1"]["bias"].spec == P()
+    # (8, 32) kernel (fc3): 256 elements < 1024 -> replicated
+    assert sh["fc3"]["kernel"].spec == P()
+
+
+def test_fsdp_state_is_sharded_including_adam_moments():
+    (g,) = setup_groups(1)
+    model = VAE(hidden_dim=32, latent_dim=8)
+    state = create_train_state(
+        g, model, optax.adam(1e-3), jax.random.key(0),
+        param_shardings=fsdp_param_shardings(
+            g,
+            model.init(
+                {"params": jax.random.key(0), "reparam": jax.random.key(0)},
+                jnp.zeros((1, 784), jnp.float32),
+            )["params"],
+        ),
+    )
+    k = state.params["fc1"]["kernel"]
+    assert k.shape == (784, 32)
+    assert k.addressable_shards[0].data.shape == (98, 32)  # 784/8
+    mu = state.opt_state[0].mu["fc1"]["kernel"]
+    assert mu.addressable_shards[0].data.shape == (98, 32)
+
+
+def test_fsdp_training_matches_replicated_dp():
+    def losses(fsdp: bool, steps: int = 4):
+        (g,) = setup_groups(1)
+        model = VAE(hidden_dim=32, latent_dim=8)
+        tx = optax.adam(1e-3)
+        if fsdp:
+            params = model.init(
+                {"params": jax.random.key(0), "reparam": jax.random.key(0)},
+                jnp.zeros((1, 784), jnp.float32),
+            )["params"]
+            state = create_train_state(
+                g, model, tx, jax.random.key(0),
+                param_shardings=fsdp_param_shardings(g, params),
+            )
+            shardings = state_shardings(state)
+        else:
+            state = create_train_state(g, model, tx, jax.random.key(0))
+            shardings = None
+        step = make_train_step(g, model, tx, shardings=shardings)
+        batch = jax.device_put(
+            jnp.asarray(
+                np.random.default_rng(0)
+                .uniform(0, 1, (16, 784))
+                .astype(np.float32)
+            ),
+            g.batch_sharding,
+        )
+        out = []
+        for i in range(steps):
+            state, m = step(
+                state, batch, jax.random.fold_in(jax.random.key(7), i)
+            )
+            out.append(float(m["loss_sum"]))
+        return out
+
+    np.testing.assert_allclose(losses(False), losses(True), rtol=2e-4)

@@ -1,0 +1,116 @@
+"""Expert-parallel MoE (ops/moe.py): routing semantics, EP-vs-replicated
+parity, and training through the dispatch einsums. The reference has no
+MoE/EP at all (SURVEY.md §2c). 8 virtual CPU devices."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from jax.sharding import PartitionSpec as P
+
+from multidisttorch_tpu.ops.moe import MoEMLP, moe_ep_shardings
+from multidisttorch_tpu.parallel.mesh import MODEL_AXIS, setup_groups
+
+
+def _model(e=4, cap=4.0):
+    return MoEMLP(
+        num_experts=e, hidden_dim=16, out_dim=8, capacity_factor=cap
+    )
+
+
+def _init(model, d=12, b=16):
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(b, d)).astype(np.float32)
+    )
+    params = model.init(jax.random.key(0), x)["params"]
+    return params, x
+
+
+def test_forward_shapes_and_aux():
+    model = _model()
+    params, x = _init(model)
+    y, aux = model.apply({"params": params}, x)
+    assert y.shape == (16, 8)
+    assert np.isfinite(float(aux))
+    # aux is minimized at 1.0 for perfectly uniform routing; >= ~1 here
+    assert float(aux) >= 0.99
+
+
+def test_capacity_drops_overflow_tokens():
+    # capacity_factor small enough that at most 1 token per expert is
+    # served: dropped tokens must contribute exactly zero output.
+    model = _model(e=2, cap=0.1)  # cap = ceil(16*0.1/2) = 1
+    params, x = _init(model, b=16)
+    y, _ = model.apply({"params": params}, x)
+    served = np.count_nonzero(np.any(np.asarray(y) != 0.0, axis=-1))
+    # at most one token per expert — and at least one token actually
+    # served, so an all-zero combine path can't pass vacuously
+    assert 1 <= served <= 2
+
+
+def test_expert_parallel_matches_replicated():
+    # The same params evaluated replicated vs expert-sharded over a
+    # (data x model) submesh must agree — GSPMD partitioning of the
+    # dispatch/compute/combine einsums is semantics-preserving.
+    model = _model()
+    params, x = _init(model)
+    y_ref, aux_ref = model.apply({"params": params}, x)
+
+    (g,) = setup_groups(1, model_parallel=4)
+    sh = moe_ep_shardings(g, params)
+    assert sh["w1"].spec == P(MODEL_AXIS, None, None)
+    assert sh["gate"]["kernel"].spec == P()
+    params_ep = jax.device_put(params, sh)
+    x_ep = jax.device_put(x, g.batch_sharding)
+
+    @jax.jit
+    def fwd(p, xx):
+        return model.apply({"params": p}, xx)
+
+    y_ep, aux_ep = fwd(params_ep, x_ep)
+    np.testing.assert_allclose(
+        np.asarray(y_ep), np.asarray(y_ref), rtol=2e-4, atol=1e-5
+    )
+    assert float(aux_ep) == pytest.approx(float(aux_ref), rel=1e-4)
+    # experts are physically sharded: 4 experts over model axis of 4
+    assert params_ep["w1"].addressable_shards[0].data.shape[0] == 1
+
+
+def test_moe_trains_expert_sharded():
+    model = _model()
+    params, x = _init(model)
+    target = jnp.asarray(
+        np.random.default_rng(1).normal(size=(16, 8)).astype(np.float32)
+    )
+    (g,) = setup_groups(1, model_parallel=2)
+    sh = moe_ep_shardings(g, params)
+    params = jax.device_put(params, sh)
+    x_ep = jax.device_put(x, g.batch_sharding)
+    tx = optax.adam(3e-3)
+    opt = jax.tree.map(lambda p: g.device_put(p), tx.init(params))
+
+    @jax.jit
+    def step(params, opt):
+        def loss_fn(p):
+            y, aux = model.apply({"params": p}, x_ep)
+            return jnp.mean((y - target) ** 2) + 0.01 * aux
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        upd, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, upd), opt, loss
+
+    losses = []
+    for _ in range(30):
+        params, opt, loss = step(params, opt)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_ep_shardings_reject_indivisible_experts():
+    model = MoEMLP(num_experts=3, hidden_dim=8, out_dim=4)
+    params, _ = _init(model)
+    (g,) = setup_groups(1, model_parallel=2)
+    with pytest.raises(ValueError, match="num_experts"):
+        moe_ep_shardings(g, params)
