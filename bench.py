@@ -352,36 +352,41 @@ def _flagship_setup(num_groups: int = 1):
     return groups, model, tx
 
 
-def bench_ours() -> float:
+def _timed_chunks(trial, model, tx, **step_kwargs) -> float:
+    """The one measurement protocol: scan-fused dispatch (CHUNK_STEPS
+    optimizer updates per host round-trip — the TPU-idiomatic shape of
+    the reference's per-batch loop, vae-hpo.py:67-74), one warmup
+    compile, MEASURE_CHUNKS timed chunks. Returns samples/sec (whole
+    submesh). Every bench mode that times training goes through here so
+    protocol changes can't drift between the headline number and the
+    comparisons derived from it."""
     from multidisttorch_tpu.train.steps import create_train_state, make_multi_step
 
-    ndev = len(jax.devices())
-    (trial,), model, tx = _flagship_setup(1)
     state = create_train_state(trial, model, tx, jax.random.key(0))
-    # Dispatch-amortized training: the device runs CHUNK_STEPS optimizer
-    # updates per host round-trip (lax.scan over the step body) — the
-    # TPU-idiomatic shape of the reference's per-batch loop
-    # (vae-hpo.py:67-74), where each iteration crossed the host/device
-    # boundary twice.
-    multi = make_multi_step(trial, model, tx)
-
-    batches_np = np.random.default_rng(0).uniform(
-        0, 1, (CHUNK_STEPS, BATCH, 784)
-    ).astype(np.float32)
+    multi = make_multi_step(trial, model, tx, **step_kwargs)
     batches = jax.device_put(
-        jnp.asarray(batches_np), trial.sharding(None, "data")
+        jnp.asarray(
+            np.random.default_rng(0)
+            .uniform(0, 1, (CHUNK_STEPS, BATCH, 784))
+            .astype(np.float32)
+        ),
+        trial.sharding(None, "data"),
     )
     key = jax.random.key(1)
-
     state, _ = multi(state, batches, key)  # compile + warmup
     jax.block_until_ready(state.params)
-
     t0 = time.perf_counter()
     for i in range(MEASURE_CHUNKS):
-        state, m = multi(state, batches, jax.random.fold_in(key, i))
+        state, _ = multi(state, batches, jax.random.fold_in(key, i))
     jax.block_until_ready(state.params)
     dt = time.perf_counter() - t0
-    return MEASURE_CHUNKS * CHUNK_STEPS * BATCH / dt / ndev
+    return MEASURE_CHUNKS * CHUNK_STEPS * BATCH / dt
+
+
+def bench_ours() -> float:
+    ndev = len(jax.devices())
+    (trial,), model, tx = _flagship_setup(1)
+    return _timed_chunks(trial, model, tx) / ndev
 
 
 def bench_fused_loss_comparison() -> dict:
@@ -394,31 +399,11 @@ def bench_fused_loss_comparison() -> dict:
     use_fused_loss's default. Skipped off-TPU (interpret-mode Pallas
     timings are meaningless).
     """
-    from multidisttorch_tpu.train.steps import create_train_state, make_multi_step
-
     (trial,), model, tx = _flagship_setup(1)
-    batches = jax.device_put(
-        jnp.asarray(
-            np.random.default_rng(0)
-            .uniform(0, 1, (CHUNK_STEPS, BATCH, 784))
-            .astype(np.float32)
-        ),
-        trial.sharding(None, "data"),
-    )
-    key = jax.random.key(1)
     out = {}
     for label, fused in (("xla_loss", False), ("pallas_fused_loss", True)):
-        state = create_train_state(trial, model, tx, jax.random.key(0))
-        multi = make_multi_step(trial, model, tx, use_fused_loss=fused)
-        state, _ = multi(state, batches, key)  # compile + warmup
-        jax.block_until_ready(state.params)
-        t0 = time.perf_counter()
-        for i in range(MEASURE_CHUNKS):
-            state, _ = multi(state, batches, jax.random.fold_in(key, i))
-        jax.block_until_ready(state.params)
-        dt = time.perf_counter() - t0
         out[label + "_samples_per_sec"] = round(
-            MEASURE_CHUNKS * CHUNK_STEPS * BATCH / dt, 1
+            _timed_chunks(trial, model, tx, use_fused_loss=fused), 1
         )
     out["winner"] = (
         "pallas"

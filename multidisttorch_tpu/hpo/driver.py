@@ -93,6 +93,10 @@ class TrialConfig:
     # tighter bound); True reproduces the reference's sampled test-loss
     # metric for apples-to-apples quality comparison.
     eval_sampled: bool = False
+    # Rematerialize activations in the backward pass (jax.checkpoint):
+    # trade recompute FLOPs for HBM when the model or the fused-steps
+    # scan outgrows device memory. Numerically identical training.
+    remat: bool = False
 
 
 @dataclass
@@ -202,9 +206,13 @@ class _TrialRun:
         self.state = create_train_state(
             trial, model, tx, jax.random.key(cfg.seed)
         )
-        self.train_step = make_train_step(trial, model, tx, beta=cfg.beta)
+        self.train_step = make_train_step(
+            trial, model, tx, beta=cfg.beta, remat=cfg.remat
+        )
         self.multi_step = (
-            make_multi_step(trial, model, tx, beta=cfg.beta)
+            make_multi_step(
+                trial, model, tx, beta=cfg.beta, remat=cfg.remat
+            )
             if cfg.fused_steps > 1
             else None
         )

@@ -148,9 +148,16 @@ def _build_step_fn(
     tx: optax.GradientTransformation,
     beta: float,
     use_fused_loss: bool,
+    remat: bool = False,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
     """The un-jitted train-step body shared by :func:`make_train_step`
-    (one step per dispatch) and :func:`make_multi_step` (scan-fused)."""
+    (one step per dispatch) and :func:`make_multi_step` (scan-fused).
+
+    ``remat=True`` wraps the forward in ``jax.checkpoint``: activations
+    are recomputed during the backward pass instead of stored — the
+    standard HBM-for-FLOPs trade when a model (or a long scan of fused
+    steps) outgrows device memory. Numerically identical training.
+    """
     loss_impl = elbo_loss_sum
     if use_fused_loss:
         from jax.sharding import PartitionSpec as _P
@@ -180,13 +187,17 @@ def _build_step_fn(
                     check_vma=False,
                 )(logits, x, mu, logvar)
 
+    def forward(params, batch, rng):
+        return model.apply({"params": params}, batch, rngs={"reparam": rng})
+
+    if remat:
+        forward = jax.checkpoint(forward)
+
     def step_fn(state: TrainState, batch: jax.Array, rng: jax.Array):
         n = batch.shape[0]
 
         def loss_fn(params):
-            recon_logits, mu, logvar = model.apply(
-                {"params": params}, batch, rngs={"reparam": rng}
-            )
+            recon_logits, mu, logvar = forward(params, batch, rng)
             total = loss_impl(
                 recon_logits, batch.reshape(n, -1), mu, logvar, beta
             )
@@ -212,6 +223,7 @@ def make_train_step(
     beta: float = 1.0,
     use_fused_loss: bool = False,
     shardings: Any = None,
+    remat: bool = False,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
     """Build the compiled train step for one trial submesh.
 
@@ -232,7 +244,7 @@ def make_train_step(
     """
     repl = trial.replicated_sharding
     data = trial.batch_sharding
-    step_fn = _build_step_fn(trial, model, tx, beta, use_fused_loss)
+    step_fn = _build_step_fn(trial, model, tx, beta, use_fused_loss, remat)
     state_sh = repl if shardings is None else shardings
     return jax.jit(
         step_fn,
@@ -250,6 +262,7 @@ def make_multi_step(
     beta: float = 1.0,
     use_fused_loss: bool = False,
     shardings: Any = None,
+    remat: bool = False,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
     """K chained train steps in ONE dispatch, via ``lax.scan``.
 
@@ -268,7 +281,7 @@ def make_multi_step(
     :func:`make_train_step`). ``rng`` is split into K per-step keys
     inside the compiled program.
     """
-    step_fn = _build_step_fn(trial, model, tx, beta, use_fused_loss)
+    step_fn = _build_step_fn(trial, model, tx, beta, use_fused_loss, remat)
     repl = trial.replicated_sharding
     batches_sh = trial.sharding(None, DATA_AXIS)
     state_sh = repl if shardings is None else shardings

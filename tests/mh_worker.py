@@ -217,13 +217,15 @@ def main():
         }
 
     elif mode == "pbt":
-        # Population of 2, one member per process; cross-process exploit
-        # moves weights via broadcast_one_to_all. Both processes must
-        # report identical global decisions.
+        # Cross-process exploit moves weights via broadcast_one_to_all;
+        # every process must report identical global decisions.
+        # Population defaults to 2 (one member per process in the 2x4
+        # world); MH_PBT_POP scales it for wider worlds.
         from multidisttorch_tpu.hpo.pbt import PBTConfig, run_pbt
 
         cfg = PBTConfig(
-            population=2, generations=2, steps_per_generation=4,
+            population=int(os.environ.get("MH_PBT_POP", "2")),
+            generations=2, steps_per_generation=4,
             batch_size=16, hidden_dim=16, latent_dim=4,
             exploit_fraction=0.5, lr_min=1e-4, lr_max=1e-1, seed=0,
         )

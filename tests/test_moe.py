@@ -89,7 +89,8 @@ def test_moe_trains_expert_sharded():
     params = jax.device_put(params, sh)
     x_ep = jax.device_put(x, g.batch_sharding)
     tx = optax.adam(3e-3)
-    opt = jax.tree.map(lambda p: g.device_put(p), tx.init(params))
+    # computation-follows-data: moments inherit the expert sharding
+    opt = tx.init(params)
 
     @jax.jit
     def step(params, opt):

@@ -37,6 +37,7 @@ def _launch(
     nprocs: int = 2,
     devs_per_proc: int = 4,
     timeout: int = 420,
+    extra_env: dict | None = None,
 ) -> list[dict]:
     """Run ``nprocs`` worker ranks through the framework's own
     OpenMPI-style env detection; return every RESULT payload.
@@ -55,6 +56,7 @@ def _launch(
             MASTER_ADDR="127.0.0.1",
             MASTER_PORT=str(port),
             MH_DEVS_PER_PROC=str(devs_per_proc),
+            **(extra_env or {}),
         )
         procs.append(
             subprocess.Popen(
@@ -215,6 +217,25 @@ def test_uneven_ownership_spanning_groups(tmp_path):
     # writers: group 0's first device is on proc 0; group 1's on proc 1
     assert rs[0]["wrote_ckpt"]["0"] and not rs[1]["wrote_ckpt"]["0"]
     assert rs[1]["wrote_ckpt"]["1"] and not rs[2]["wrote_ckpt"]["1"]
+
+
+@pytest.mark.multihost
+def test_pbt_four_processes_population4_agrees(tmp_path):
+    # PBT's global decisions (scores, ranking, exploits, perturbed lrs)
+    # must agree across FOUR processes with a 4-member population (one
+    # member per 2-device group, each wholly owned by one process), with
+    # at least one exploit crossing a process boundary.
+    rs = _launch(
+        "pbt", tmp_path, nprocs=4, devs_per_proc=2, timeout=600,
+        extra_env={"MH_PBT_POP": "4"},
+    )
+    assert len(rs) == 4
+    for r in rs[1:]:
+        assert r["best_member"] == rs[0]["best_member"]
+        assert r["best_eval_loss"] == rs[0]["best_eval_loss"]
+        assert r["final_lrs"] == rs[0]["final_lrs"]
+        assert r["scores"] == rs[0]["scores"]
+    assert rs[0]["n_exploits"] >= 1
 
 
 @pytest.mark.multihost

@@ -242,3 +242,34 @@ def test_concurrent_trials_independent_results():
             last[j] = float(m["loss_sum"])
     assert last[0] == pytest.approx(alone[0], rel=1e-5)
     assert last[1] == pytest.approx(alone[1], rel=1e-5)
+
+
+def test_remat_training_is_numerically_identical():
+    # jax.checkpoint recomputes activations in the backward pass; the
+    # optimizer trajectory must not change at all (same grads, same
+    # updates) — only the memory/FLOPs schedule does.
+    model = VAE(hidden_dim=32, latent_dim=8)
+    (trial,) = setup_groups(1)
+    batch = _synthetic_batch(np.random.default_rng(9), 16)
+    key = jax.random.key(3)
+
+    def run(remat):
+        tx = optax.adam(1e-3)
+        s = create_train_state(trial, model, tx, jax.random.key(1))
+        step = make_train_step(trial, model, tx, remat=remat)
+        losses = []
+        for i in range(3):
+            s, m = step(s, batch, jax.random.fold_in(key, i))
+            losses.append(float(m["loss_sum"]))
+        return losses, s
+
+    plain_losses, plain_state = run(False)
+    remat_losses, remat_state = run(True)
+    np.testing.assert_allclose(plain_losses, remat_losses, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7
+        ),
+        plain_state.params,
+        remat_state.params,
+    )
