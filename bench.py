@@ -357,9 +357,11 @@ def _timed_chunks(trial, model, tx, **step_kwargs) -> float:
     optimizer updates per host round-trip — the TPU-idiomatic shape of
     the reference's per-batch loop, vae-hpo.py:67-74), one warmup
     compile, MEASURE_CHUNKS timed chunks. Returns samples/sec (whole
-    submesh). Every bench mode that times training goes through here so
-    protocol changes can't drift between the headline number and the
-    comparisons derived from it."""
+    submesh). Both single-trial throughput modes (the headline number
+    and the fused-loss comparison that decides defaults against it) go
+    through here so those two can't drift; bench_concurrency and
+    bench_to_elbo measure deliberately different things (interleaved
+    multi-trial dispatch; loss-gated wall-clock) with their own loops."""
     from multidisttorch_tpu.train.steps import create_train_state, make_multi_step
 
     state = create_train_state(trial, model, tx, jax.random.key(0))

@@ -1,4 +1,5 @@
 from multidisttorch_tpu.models.conv_vae import ConvVAE, conv_tp_shardings
+from multidisttorch_tpu.models.moe_vae import MoEVAE, moe_vae_ep_shardings
 from multidisttorch_tpu.models.resnet import (
     ResNet,
     ResNet18,

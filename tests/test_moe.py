@@ -115,3 +115,73 @@ def test_ep_shardings_reject_indivisible_experts():
     (g,) = setup_groups(1, model_parallel=2)
     with pytest.raises(ValueError, match="num_experts"):
         moe_ep_shardings(g, params)
+
+
+def test_moe_vae_runs_through_full_hpo_driver():
+    # The model-family contract: an MoE-decoder VAE drops into the HPO
+    # driver via model_builder with zero scaffolding changes — trial x
+    # data parallelism from the driver, the MoE block inside.
+    from multidisttorch_tpu.data.datasets import synthetic_mnist
+    from multidisttorch_tpu.hpo.driver import TrialConfig, run_hpo
+    from multidisttorch_tpu.models.moe_vae import MoEVAE
+
+    train = synthetic_mnist(96, seed=0)
+    test = synthetic_mnist(32, seed=1)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        results = run_hpo(
+            [
+                TrialConfig(t, epochs=1, batch_size=16, hidden_dim=32,
+                            latent_dim=8, seed=t)
+                for t in range(2)
+            ],
+            train,
+            test,
+            out_dir=td,
+            verbose=False,
+            save_images=False,
+            model_builder=lambda cfg: MoEVAE(
+                hidden_dim=cfg.hidden_dim, latent_dim=cfg.latent_dim,
+                num_experts=2,
+            ),
+        )
+    for r in results:
+        assert r.status == "completed"
+        assert np.isfinite(r.final_train_loss)
+        assert np.isfinite(r.final_test_loss)
+
+
+def test_moe_vae_expert_parallel_train_step():
+    # data x model submesh: experts sharded within the trial; TP-style
+    # state pinning through the standard step builder.
+    from multidisttorch_tpu.models.moe_vae import MoEVAE, moe_vae_ep_shardings
+    from multidisttorch_tpu.train.steps import (
+        create_train_state,
+        make_train_step,
+        state_shardings,
+    )
+
+    (g,) = setup_groups(1, model_parallel=2)  # 4 data x 2 model
+    model = MoEVAE(hidden_dim=32, latent_dim=8, num_experts=2)
+    tx = optax.adam(1e-3)
+    state = create_train_state(
+        g, model, tx, jax.random.key(0),
+        param_shardings=moe_vae_ep_shardings(g, model),
+    )
+    # experts physically split: (2, latent, hidden) -> (1, ...) shards
+    w1 = state.params["moe"]["w1"]
+    assert w1.addressable_shards[0].data.shape[0] == 1
+    step = make_train_step(g, model, tx, shardings=state_shardings(state))
+    batch = jax.device_put(
+        jnp.asarray(
+            np.random.default_rng(0).uniform(0, 1, (16, 784)).astype(np.float32)
+        ),
+        g.batch_sharding,
+    )
+    losses = []
+    for i in range(4):
+        state, m = step(state, batch, jax.random.fold_in(jax.random.key(5), i))
+        losses.append(float(m["loss_sum"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
