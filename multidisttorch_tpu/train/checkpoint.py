@@ -32,6 +32,7 @@ def save_state(state: Any, path: str, *, metadata: Optional[dict] = None) -> str
     isn't there yet.
     """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    _require_fully_addressable(state, "save_state")
     host_state = jax.device_get(state)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -45,12 +46,56 @@ def save_state(state: Any, path: str, *, metadata: Optional[dict] = None) -> str
     return path
 
 
-def restore_state(template: Any, path: str, trial: Optional[TrialMesh] = None) -> Any:
-    """Restore into the structure of ``template``; optionally place
-    replicated onto ``trial``'s submesh (checkpoint-restart or PBT
-    exploit onto a different device group)."""
+def _require_fully_addressable(tree: Any, op: str) -> None:
+    """Serialization reads whole arrays on this host. A process-spanning
+    *replicated* state is fine (every shard is a full copy); a
+    weight-SHARDED state on a process-spanning submesh is not — this
+    process doesn't hold the other processes' shards, and a collective
+    gather can't happen here because the driver writer-gates checkpoint
+    I/O to ONE process. Fail with the contract instead of jax's opaque
+    span error: callers with such states gather to replicated on all
+    owners first, then let the writer save."""
+    for leaf in jax.tree.leaves(tree):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            if getattr(leaf.sharding, "is_fully_replicated", False):
+                continue  # every process holds a complete copy
+            raise ValueError(
+                f"{op}: state leaf (shape {leaf.shape}) is sharded across "
+                "processes and not fully addressable here. Gather it to "
+                "replicated on every owner process first (one process "
+                "cannot serialize shards it does not hold)."
+            )
+
+
+def restore_state(
+    template: Any,
+    path: str,
+    trial: Optional[TrialMesh] = None,
+    *,
+    shardings: Any = None,
+) -> Any:
+    """Restore into the structure of ``template``; optionally place onto
+    ``trial``'s submesh (checkpoint-restart or PBT exploit onto a
+    different device group).
+
+    Placement defaults to replicated — correct for the plain-DP trials
+    the driver runs. A weight-sharded state (TP/FSDP/EP) must pass its
+    ``shardings`` pytree (``train.steps.state_shardings`` of the live
+    state) or the restore silently lands fully replicated, costing the
+    sharding's whole memory benefit until the first step reshards it.
+    (Cross-PROCESS-sharded templates additionally need a gather before
+    save — see :func:`save_state`'s addressability contract; restore
+    placement itself is multi-process safe via ``TrialMesh.device_put``.)
+    """
+    if shardings is not None and trial is None:
+        raise ValueError(
+            "restore_state: shardings= requires trial= (the submesh to "
+            "place onto); without it the shardings would be silently "
+            "ignored"
+        )
+    _require_fully_addressable(template, "restore_state")
     with open(path, "rb") as f:
         restored = serialization.from_bytes(jax.device_get(template), f.read())
     if trial is not None:
-        restored = trial.device_put(restored)
+        restored = trial.device_put(restored, shardings)
     return restored

@@ -77,6 +77,47 @@ class TestCheckpoint:
         restored, m = step1(restored, batch, jax.random.key(2))
         assert np.isfinite(float(m["loss_sum"]))
 
+    def test_sharded_state_roundtrip_keeps_sharding(self, tmp_path):
+        # A TP-sharded state must restore SHARDED (round-4: restore_state
+        # grew a shardings= arg; without it the restore lands replicated
+        # and the memory benefit silently evaporates).
+        from multidisttorch_tpu.models.vae import vae_tp_shardings
+        from multidisttorch_tpu.train.steps import state_shardings
+
+        model = VAE(hidden_dim=16, latent_dim=4)
+        tx = optax.adam(1e-3)
+        (g,) = setup_groups(1, model_parallel=4)
+        state = create_train_state(
+            g, model, tx, jax.random.key(0),
+            param_shardings=vae_tp_shardings(g),
+        )
+        sh = state_shardings(state)
+        step = make_train_step(g, model, tx, shardings=sh)
+        batch = jax.device_put(
+            jax.numpy.asarray(
+                np.random.default_rng(1)
+                .uniform(0, 1, (8, 784))
+                .astype(np.float32)
+            ),
+            g.batch_sharding,
+        )
+        state, _ = step(state, batch, jax.random.key(1))
+
+        path = save_state(state, str(tmp_path / "tp" / "state.msgpack"))
+        restored = restore_state(state, path, trial=g, shardings=sh)
+        k = restored.params["fc1"]["kernel"]
+        assert k.addressable_shards[0].data.shape == (784, 4)  # 16/4
+        # values identical and training continues sharded
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            jax.device_get(restored.params),
+            jax.device_get(state.params),
+        )
+        restored, m = step(restored, batch, jax.random.key(2))
+        assert np.isfinite(float(m["loss_sum"]))
+
 
 class TestProfiling:
     def test_trial_timer_prints_reference_format(self, capsys):
