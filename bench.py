@@ -545,6 +545,121 @@ def bench_concurrency(num_trials: int) -> dict:
     return out
 
 
+def bench_loader(rows: int = 60000, dim: int = 784, batch: int = BATCH) -> dict:
+    """Host batch-assembly throughput: C++ prefetching gatherer
+    (csrc/fastloader.cpp) vs the equivalent pure-numpy gather.
+
+    The data path is the host-side hot loop of every sweep (SURVEY §7
+    "hard parts": contention is host-side). Two conditions:
+
+    - ``bare``: fetch batches back to back. This measures raw copy
+      speed, where numpy fancy-indexing usually WINS — the native
+      gatherer pays an extra copy-out. Recorded because an honest
+      artifact must show where the native path does not help.
+    - ``interleaved``: a bandwidth-heavy numpy matmul between fetches.
+      Deliberately adversarial to the prefetch thread (the matmul
+      releases the GIL and saturates memory bandwidth) — kept in the
+      artifact as the native path's worst case.
+    - ``train_loop`` (the headline): the REAL consumer — a
+      ``TrialDataIterator`` feeding scan-fused train dispatches — with
+      the native gatherer on vs off. This is the condition the
+      auto-enable default is judged by: device dispatch holds the GIL
+      briefly and leaves bandwidth idle, which is exactly when the
+      background gather pays."""
+    from multidisttorch_tpu.data import native
+
+    rng = np.random.default_rng(0)
+    images = rng.uniform(0, 1, (rows, dim)).astype(np.float32)
+    perm = rng.permutation(rows)
+    n_batches = rows // batch
+    work_a = rng.normal(size=(256, 256)).astype(np.float32)
+
+    def work():
+        return work_a @ work_a
+
+    def timed(fetch, interleave: bool) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_batches):
+            fetch()
+            if interleave:
+                work()
+        return n_batches * batch / (time.perf_counter() - t0)
+
+    def numpy_fetch(i=[0]):
+        j = i[0] % n_batches
+        i[0] += 1
+        return images[perm[j * batch : (j + 1) * batch]]
+
+    out = {
+        "bare": {
+            "numpy_samples_per_sec": round(timed(numpy_fetch, False), 1)
+        },
+        "interleaved": {
+            "numpy_samples_per_sec": round(timed(numpy_fetch, True), 1)
+        },
+        "native_available": native.available(),
+    }
+    if native.available():
+        g = native.NativeBatchGatherer(images)
+        for cond, interleave in (("bare", False), ("interleaved", True)):
+            n = g.start_epoch(perm, batch)  # warm epoch per condition
+            for _ in range(n):
+                g.next_batch()
+            n = g.start_epoch(perm, batch)
+            sps = timed(g.next_batch, interleave)
+            out[cond]["native_samples_per_sec"] = round(sps, 1)
+            out[cond]["native_vs_numpy"] = round(
+                sps / out[cond]["numpy_samples_per_sec"], 3
+            )
+        g.close()
+
+    # Real-consumer condition runs either way (python-only rate still
+    # meaningful without the native library).
+    out["train_loop"] = _loader_train_loop(
+        rows, batch, with_native=native.available()
+    )
+    return out
+
+
+def _loader_train_loop(rows: int, batch: int, *, with_native: bool) -> dict:
+    """Real-consumer loader A/B: one epoch of scan-fused training fed by
+    TrialDataIterator with the native gatherer off vs on."""
+    from multidisttorch_tpu.data.datasets import synthetic_mnist
+    from multidisttorch_tpu.data.sampler import TrialDataIterator
+    from multidisttorch_tpu.train.steps import create_train_state, make_multi_step
+
+    chunk = 10
+    (trial,), model, tx = _flagship_setup(1)
+    data = synthetic_mnist(rows, seed=0)
+    key = jax.random.key(1)
+    res = {}
+    for use_native in (False, True) if with_native else (False,):
+        it = TrialDataIterator(
+            data, trial, batch, seed=0, use_native=use_native
+        )
+        state = create_train_state(trial, model, tx, jax.random.key(0))
+        multi = make_multi_step(trial, model, tx)
+        state, _ = multi(state, next(it.stream_chunks(chunk)), key)
+        jax.block_until_ready(state.params)
+        t0 = time.perf_counter()
+        n = 0
+        for i, item in enumerate(it.epoch_chunks(1, chunk)):
+            if item[1].shape[0] != chunk:
+                break
+            state, _ = multi(state, item[1], jax.random.fold_in(key, i))
+            n += chunk * batch
+        jax.block_until_ready(state.params)
+        label = "native" if use_native else "python"
+        res[label + "_samples_per_sec"] = round(
+            n / (time.perf_counter() - t0), 1
+        )
+    if "native_samples_per_sec" in res:
+        res["native_vs_python"] = round(
+            res["native_samples_per_sec"] / res["python_samples_per_sec"], 3
+        )
+    return res
+
+
 def bench_to_elbo(target: float, max_steps: int = 20000) -> dict:
     """BASELINE.json's second metric: HPO wall-clock to target ELBO.
 
@@ -605,12 +720,44 @@ def main():
         help="measure wall-clock (s) until the per-sample train ELBO "
         "drops below this target (BASELINE.json's second metric)",
     )
+    parser.add_argument(
+        "--loader", action="store_true",
+        help="measure host batch-assembly throughput: native C++ "
+        "gatherer vs pure numpy",
+    )
     args = parser.parse_args()
 
-    if args.concurrency is not None and args.to_elbo is not None:
-        parser.error("--concurrency and --to-elbo are mutually exclusive")
+    if sum(x is not None and x is not False
+           for x in (args.concurrency, args.to_elbo, args.loader)) > 1:
+        parser.error("--concurrency/--to-elbo/--loader are mutually exclusive")
 
+    # Every mode goes through the preflight first: the train_loop loader
+    # condition (and all training modes) touch jax.devices(), which on a
+    # wedged-TPU machine blocks forever without the probe + CPU fallback.
     backend = _ensure_backend()
+
+    if args.loader:
+        r = bench_loader()
+        r.update(backend)
+        tl = r["train_loop"]
+        # Headline is always a train-loop rate — python-path when the
+        # native library is absent, never the bare memcpy number (three
+        # orders of magnitude larger and not comparable).
+        print(
+            json.dumps(
+                {
+                    "metric": "loader_train_loop_throughput",
+                    "value": tl.get(
+                        "native_samples_per_sec",
+                        tl["python_samples_per_sec"],
+                    ),
+                    "unit": "samples/sec",
+                    "vs_baseline": tl.get("native_vs_python"),
+                    "detail": r,
+                }
+            )
+        )
+        return
 
     if args.to_elbo is not None:
         r = bench_to_elbo(args.to_elbo)

@@ -55,6 +55,16 @@ def main():
         "(default 10 = the log cadence; 1 reproduces the reference's "
         "one-dispatch-per-batch loop shape)",
     )
+    parser.add_argument(
+        "--eval-sampled", action="store_true",
+        help="reproduce the reference's sampled-z test loss "
+        "(vae-hpo.py:101-105) instead of the default posterior-mean eval",
+    )
+    parser.add_argument(
+        "--remat", action="store_true",
+        help="rematerialize activations in the backward pass "
+        "(jax.checkpoint) — trade FLOPs for HBM",
+    )
     args = parser.parse_args()
 
     nproc, pid = mdt.initialize_runtime()
@@ -78,6 +88,8 @@ def main():
             beta=args.beta,
             seed=g,
             fused_steps=args.fused_steps,
+            eval_sampled=args.eval_sampled,
+            remat=args.remat,
         )
         for g in range(args.ngroups)
     ]

@@ -60,6 +60,7 @@ from multidisttorch_tpu.train.steps import (
     make_multi_step,
     make_sample_step,
     make_train_step,
+    state_shardings,
 )
 from multidisttorch_tpu.utils.imaging import save_image_grid
 from multidisttorch_tpu.utils.logging import log0
@@ -152,6 +153,7 @@ class _TrialRun:
         save_checkpoint: bool = True,
         verbose: bool = True,
         model_builder=None,
+        param_shardings_builder=None,
         resume: bool = False,
         agree_failures: bool = False,
     ):
@@ -203,15 +205,40 @@ class _TrialRun:
             model = model_builder(cfg)
         tx = optax.adam(cfg.lr)
         self.model, self.tx = model, tx
+        # Within-trial weight sharding (TP/EP/FSDP): the builder maps
+        # (trial, model) -> a param-shardings pytree (e.g.
+        # models.vae.vae_tp_shardings, models.moe_vae.moe_vae_ep_shardings);
+        # the derived state shardings then pin every step's layout.
+        param_sh = (
+            param_shardings_builder(trial, model)
+            if param_shardings_builder is not None
+            else None
+        )
         self.state = create_train_state(
-            trial, model, tx, jax.random.key(cfg.seed)
+            trial, model, tx, jax.random.key(cfg.seed),
+            param_shardings=param_sh,
+        )
+        self._state_sh = (
+            state_shardings(self.state) if param_sh is not None else None
+        )
+        # Checkpointing a weight-sharded state: serialization needs the
+        # whole array on the writer host, but on a spanning submesh the
+        # writer holds only its shards. The gather-to-replicated below
+        # is DISPATCHED by every owner (uniform SPMD program — the same
+        # rule as every other step); only the fetch stays writer-gated.
+        self._gather_state = (
+            jax.jit(lambda s: s, out_shardings=trial.replicated_sharding)
+            if param_sh is not None
+            else None
         )
         self.train_step = make_train_step(
-            trial, model, tx, beta=cfg.beta, remat=cfg.remat
+            trial, model, tx, beta=cfg.beta, remat=cfg.remat,
+            shardings=self._state_sh,
         )
         self.multi_step = (
             make_multi_step(
-                trial, model, tx, beta=cfg.beta, remat=cfg.remat
+                trial, model, tx, beta=cfg.beta, remat=cfg.remat,
+                shardings=self._state_sh,
             )
             if cfg.fused_steps > 1
             else None
@@ -227,8 +254,11 @@ class _TrialRun:
             with_recon=save_images,
             masked=True,
             sampled=cfg.eval_sampled,
+            shardings=self._state_sh,
         )
-        self.sample_step = make_sample_step(trial, model)
+        self.sample_step = make_sample_step(
+            trial, model, shardings=self._state_sh
+        )
         self.train_iter = TrialDataIterator(
             train_data,
             trial,
@@ -297,7 +327,8 @@ class _TrialRun:
                 done = int(meta.get("completed_epochs", 0))
                 if done >= 1:
                     self.state = restore_state(
-                        self.state, self._ckpt_path, trial
+                        self.state, self._ckpt_path, trial,
+                        shardings=self._state_sh,
                     )
                     restored_step = int(jax.device_get(self.state.step))
                     if "step" in meta and restored_step != int(meta["step"]):
@@ -572,6 +603,16 @@ class _TrialRun:
 
             self.result.history.append(epoch_record)
             self.result.final_train_loss = avg
+            if self._save_checkpoint:
+                # Sharded states gather to replicated first — dispatched
+                # on ALL owners (uniform program; a writer-local gather
+                # would desynchronize a spanning submesh), making every
+                # leaf fully addressable for the writer's fetch below.
+                snap = (
+                    self._gather_state(self.state)
+                    if self._gather_state is not None
+                    else self.state
+                )
             if self._save_checkpoint and self._is_writer:
                 with self._guard():
                     # Per-epoch checkpoint = the resume boundary. Keep
@@ -580,10 +621,11 @@ class _TrialRun:
                     # keep dispatching, then hand the serialize+disk-
                     # write to a background thread. The snapshot is
                     # taken before the next epoch's first step, so
-                    # donation can't invalidate it.
-                    jax.tree.map(lambda x: x.copy_to_host_async(), self.state)
+                    # donation can't invalidate it (the gathered copy is
+                    # its own buffer in the sharded case).
+                    jax.tree.map(lambda x: x.copy_to_host_async(), snap)
                     yield
-                    host_state = jax.device_get(self.state)
+                    host_state = jax.device_get(snap)
                     meta = {
                         **asdict(cfg),
                         "completed_epochs": epoch,
@@ -654,6 +696,8 @@ def run_hpo(
     save_checkpoints: bool = True,
     verbose: bool = True,
     model_builder=None,
+    model_parallel: int = 1,
+    param_shardings_builder=None,
     resilient: bool = False,
     resume: bool = False,
     profile_dir: Optional[str] = None,
@@ -675,6 +719,15 @@ def run_hpo(
     ``model_builder(cfg)`` swaps the model family (e.g. ``ConvVAE`` for
     the β-VAE CIFAR config) while reusing all scaffolding; default is
     the flagship MLP VAE.
+
+    ``model_parallel=m`` carves each trial's submesh 2-D (data × model),
+    and ``param_shardings_builder(trial, model)`` maps a trial to its
+    weight shardings (e.g. ``models.vae.vae_tp_shardings(trial)`` for
+    Megatron TP, ``models.moe_vae.moe_vae_ep_shardings`` for expert
+    parallelism, ``parallel.fsdp.fsdp_param_shardings`` for ZeRO-style
+    state sharding) — every train/eval/sample step then pins that
+    layout. Within-trial model sharding composed with trial parallelism
+    from one driver call; the reference is DP-only (SURVEY.md §2c).
 
     ``resilient=True`` isolates failures: a trial raising marks its
     result ``status="failed"`` (exception text in ``.error``), frees the
@@ -718,6 +771,8 @@ def run_hpo(
             save_checkpoints=save_checkpoints,
             verbose=verbose,
             model_builder=model_builder,
+            model_parallel=model_parallel,
+            param_shardings_builder=param_shardings_builder,
             resilient=resilient,
             resume=resume,
         )
@@ -770,12 +825,21 @@ def _run_hpo_body(
     save_checkpoints,
     verbose,
     model_builder,
+    model_parallel,
+    param_shardings_builder,
     resilient,
     resume,
 ) -> list[TrialResult]:
     if groups is None:
         groups = setup_groups(
-            num_groups if num_groups is not None else len(configs)
+            num_groups if num_groups is not None else len(configs),
+            model_parallel=model_parallel,
+        )
+    elif model_parallel != 1:
+        raise ValueError(
+            "model_parallel applies only when the driver carves the "
+            "groups; carve your own with setup_groups(..., "
+            "model_parallel=m) when passing groups="
         )
     if len(configs) < len(groups):
         raise ValueError(
@@ -821,6 +885,7 @@ def _run_hpo_body(
             save_checkpoint=save_checkpoints,
             verbose=verbose,
             model_builder=model_builder,
+            param_shardings_builder=param_shardings_builder,
             resume=resume,
             agree_failures=needs_agreement(trial),
         )

@@ -313,6 +313,7 @@ def make_eval_step(
     with_recon: bool = True,
     masked: bool = False,
     sampled: bool = False,
+    shardings: Any = None,
 ) -> Callable[..., dict]:
     """Compiled eval step: summed ELBO (+ reconstructions) for one batch.
 
@@ -341,6 +342,10 @@ def make_eval_step(
 
     repl = trial.replicated_sharding
     data = trial.batch_sharding
+    # ``shardings`` (a TrainState of NamedShardings) pins a
+    # weight-sharded state's layout on entry, same as the train steps —
+    # without it a TP/EP state would be gathered to replicated per call.
+    state_sh = repl if shardings is None else shardings
 
     def eval_core(state: TrainState, batch: jax.Array, weights, rng=None):
         n = batch.shape[0]
@@ -370,7 +375,7 @@ def make_eval_step(
     if masked and sampled:
         return jax.jit(
             eval_core,
-            in_shardings=(repl, data, data, repl),
+            in_shardings=(state_sh, data, data, repl),
             out_shardings=repl,
         )
     if masked:
@@ -378,31 +383,41 @@ def make_eval_step(
             return eval_core(state, batch, weights)
 
         return jax.jit(
-            eval_masked, in_shardings=(repl, data, data), out_shardings=repl
+            eval_masked,
+            in_shardings=(state_sh, data, data),
+            out_shardings=repl,
         )
     if sampled:
         def eval_sampled_fn(state: TrainState, batch: jax.Array, rng):
             return eval_core(state, batch, None, rng)
 
         return jax.jit(
-            eval_sampled_fn, in_shardings=(repl, data, repl), out_shardings=repl
+            eval_sampled_fn,
+            in_shardings=(state_sh, data, repl),
+            out_shardings=repl,
         )
 
     def eval_fn(state: TrainState, batch: jax.Array):
         return eval_core(state, batch, None)
 
-    return jax.jit(eval_fn, in_shardings=(repl, data), out_shardings=repl)
+    return jax.jit(eval_fn, in_shardings=(state_sh, data), out_shardings=repl)
 
 
 def make_sample_step(
-    trial: TrialMesh, model: VAE, num_samples: int = 64
+    trial: TrialMesh,
+    model: VAE,
+    num_samples: int = 64,
+    *,
+    shardings: Any = None,
 ) -> Callable[[TrainState, jax.Array], jax.Array]:
     """Compiled prior-sampling step: ``randn(n, latent) → decode``.
 
     Mirrors the reference's per-epoch sample dump
     (``vae-hpo.py:163-170``), returning pixel probabilities for imaging.
+    ``shardings`` pins a weight-sharded state's layout on entry.
     """
     repl = trial.replicated_sharding
+    state_sh = repl if shardings is None else shardings
 
     def sample_fn(state: TrainState, rng: jax.Array):
         z = jax.random.normal(rng, (num_samples, model.latent_dim))
@@ -411,4 +426,6 @@ def make_sample_step(
         )
         return probs.astype(jnp.float32)
 
-    return jax.jit(sample_fn, in_shardings=(repl, repl), out_shardings=repl)
+    return jax.jit(
+        sample_fn, in_shardings=(state_sh, repl), out_shardings=repl
+    )

@@ -184,6 +184,36 @@ def main():
             "errors": {r.trial_id: (r.error or "")[:120] for r in results},
         }
 
+    elif mode == "hpo_span_tp":
+        # Weight-SHARDED trial on a process-spanning submesh WITH
+        # checkpointing: the gather-to-replicated checkpoint path must be
+        # dispatched on every owner (round-4 driver fix) — without it the
+        # writer's device_get raises on non-addressable shards and the
+        # trial dies at the epoch agreement.
+        from multidisttorch_tpu.hpo.driver import TrialConfig, run_hpo
+        from multidisttorch_tpu.models.vae import vae_tp_shardings
+
+        cfg = TrialConfig(0, epochs=2, batch_size=16, hidden_dim=16,
+                          latent_dim=4)
+        results = run_hpo(
+            [cfg], train, test, out_dir=out_dir, num_groups=1,
+            verbose=False, save_images=False, save_checkpoints=True,
+            model_parallel=2,
+            param_shardings_builder=lambda t, m: vae_tp_shardings(t),
+        )
+        r = results[0]
+        summary = {
+            "pid": pid,
+            "status": r.status,
+            "final_train_loss": round(r.final_train_loss, 4),
+            "final_test_loss": round(r.final_test_loss, 4),
+            "steps": r.steps,
+            "wrote_ckpt": bool(r.checkpoint),
+            "ckpt_exists": os.path.exists(
+                os.path.join(out_dir, "trial-0", "state.msgpack")
+            ),
+        }
+
     elif mode == "hpo_uneven":
         # UNEVEN OWNERSHIP: carve two 3-device groups out of the first 6
         # devices of a (4 proc x 2 dev) world. Group 0 = devices 0-2

@@ -112,6 +112,42 @@ def test_per_trial_output_dirs_no_collision(tmp_path, data):
         assert r.dataset_synthetic is True
 
 
+def test_run_hpo_with_model_parallel_tp_shardings(tmp_path, data):
+    # Round-4: within-trial weight sharding through the driver itself —
+    # model_parallel carves 2-D submeshes, param_shardings_builder maps
+    # each trial to its sharding tree, and losses must match the pure-DP
+    # sweep (sharding never changes the math).
+    from multidisttorch_tpu.models.vae import vae_tp_shardings
+
+    train, test = data
+    kw = dict(
+        train_data=train, test_data=test, verbose=False, save_images=False,
+    )
+    r_dp = run_hpo(
+        [_small_cfg(0)], out_dir=str(tmp_path / "dp"), **kw
+    )[0]
+    r_tp = run_hpo(
+        [_small_cfg(0)],
+        out_dir=str(tmp_path / "tp"),
+        model_parallel=2,
+        param_shardings_builder=lambda trial, model: vae_tp_shardings(trial),
+        **kw,
+    )[0]
+    assert np.isclose(r_tp.final_train_loss, r_dp.final_train_loss, rtol=2e-4)
+    assert np.isclose(r_tp.final_test_loss, r_dp.final_test_loss, rtol=2e-4)
+
+
+def test_run_hpo_model_parallel_rejects_user_groups(tmp_path, data):
+    from multidisttorch_tpu.parallel.mesh import setup_groups
+
+    train, test = data
+    with pytest.raises(ValueError, match="model_parallel"):
+        run_hpo(
+            [_small_cfg(0)], train, test, groups=setup_groups(1),
+            model_parallel=2, out_dir=str(tmp_path), verbose=False,
+        )
+
+
 def test_balanced_assignment_beats_round_robin():
     # VERDICT r3 weak #9: multi-controller scheduling must not leave a
     # freed submesh idle behind a statically long queue. The

@@ -220,6 +220,21 @@ def test_uneven_ownership_spanning_groups(tmp_path):
 
 
 @pytest.mark.multihost
+def test_spanning_tp_trial_checkpoints(tmp_path):
+    # Weight-sharded (TP) trial spanning 2 processes with checkpointing
+    # on: the epoch checkpoint must gather-to-replicated on all owners
+    # so the writer can serialize — the sweep completes identically on
+    # both processes and the checkpoint lands on disk.
+    r0, r1 = _launch("hpo_span_tp", tmp_path)
+    for r in (r0, r1):
+        assert r["status"] == "completed", r
+        assert r["steps"] == 16
+        assert r["ckpt_exists"]
+    assert r0["final_train_loss"] == r1["final_train_loss"]
+    assert r0["wrote_ckpt"] and not r1["wrote_ckpt"]
+
+
+@pytest.mark.multihost
 def test_pbt_four_processes_population4_agrees(tmp_path):
     # PBT's global decisions (scores, ranking, exploits, perturbed lrs)
     # must agree across FOUR processes with a 4-member population (one
