@@ -1,0 +1,115 @@
+"""Causal transformer LM with pluggable (ring-parallel) attention.
+
+The reference has no attention anywhere (SURVEY.md §5: "long-context /
+sequence parallelism: absent — the model is an MLP VAE"), but
+long-context is first-class here, and an op is only first-class when a
+trainable model uses it. This is that model: a standard pre-LN decoder
+stack whose attention implementation is injected — pass
+``ops.ring_attention.make_ring_attention(trial, causal=True)`` and the
+sequence dimension shards across the trial's device axis (context
+length scales with devices, each chip holding ``T/N`` of the sequence);
+pass nothing and it runs the dense reference. Same params either way,
+so ring-vs-dense is directly comparable (tested).
+
+TPU-first details: pre-LN (stable without warmup games), learned
+positional embeddings (static shapes), GELU MLP at 4x width (MXU-sized
+matmuls), float32 params with a ``dtype`` knob for bf16 compute — the
+same conventions as the rest of ``models/``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from multidisttorch_tpu.ops.ring_attention import dense_attention_reference
+
+
+class Block(nn.Module):
+    """Pre-LN decoder block: attention + 4x GELU MLP, both residual."""
+
+    d_model: int
+    num_heads: int
+    attention: Callable  # (q, k, v) -> out, all (B, T, H, Dh); causal
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        h = self.num_heads
+        dense = lambda feats, name: nn.Dense(
+            feats, dtype=self.dtype, param_dtype=jnp.float32, name=name
+        )
+        ln = lambda name: nn.LayerNorm(
+            dtype=self.dtype, param_dtype=jnp.float32, name=name
+        )
+
+        y = ln("ln_attn")(x)
+        qkv = dense(3 * d, "qkv")(y).reshape(b, t, 3, h, d // h)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = self.attention(q, k, v).reshape(b, t, d)
+        x = x + dense(d, "proj")(attn)
+
+        y = ln("ln_mlp")(x)
+        y = dense(4 * d, "up")(y)
+        y = nn.gelu(y)
+        return x + dense(d, "down")(y)
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only LM: ``(B, T) int32 tokens -> (B, T, vocab) logits``.
+
+    ``attention`` must be causal; ``None`` uses the dense single-device
+    reference. For sequence parallelism pass
+    ``make_ring_attention(trial, causal=True)`` and shard the token
+    batch's T dimension over the trial's data axis.
+    """
+
+    vocab_size: int
+    d_model: int = 64
+    num_heads: int = 4
+    num_layers: int = 2
+    max_len: int = 256
+    attention: Optional[Callable] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        b, t = tokens.shape
+        if t > self.max_len:
+            # Trace-time check (t is a static shape): out-of-range
+            # nn.Embed gathers would silently clip/fill, not raise.
+            raise ValueError(
+                f"sequence length {t} exceeds max_len={self.max_len}"
+            )
+        attn = self.attention
+        if attn is None:
+            attn = lambda q, k, v: dense_attention_reference(
+                q, k, v, causal=True
+            )
+        x = nn.Embed(
+            self.vocab_size, self.d_model, dtype=self.dtype,
+            param_dtype=jnp.float32, name="tok_embed",
+        )(tokens)
+        pos = nn.Embed(
+            self.max_len, self.d_model, dtype=self.dtype,
+            param_dtype=jnp.float32, name="pos_embed",
+        )(jnp.arange(t)[None, :])
+        x = x + pos
+        for i in range(self.num_layers):
+            x = Block(
+                d_model=self.d_model,
+                num_heads=self.num_heads,
+                attention=attn,
+                dtype=self.dtype,
+                name=f"block_{i}",
+            )(x)
+        x = nn.LayerNorm(
+            dtype=self.dtype, param_dtype=jnp.float32, name="ln_out"
+        )(x)
+        return nn.Dense(
+            self.vocab_size, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="head",
+        )(x)

@@ -1,3 +1,8 @@
+from multidisttorch_tpu.train.lm import (
+    create_lm_state,
+    lm_loss_mean,
+    make_lm_train_step,
+)
 from multidisttorch_tpu.train.steps import (
     TrainState,
     create_train_state,

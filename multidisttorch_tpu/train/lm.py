@@ -1,0 +1,100 @@
+"""Language-model train/eval steps with sequence parallelism.
+
+Next-token objective for :class:`models.transformer.TransformerLM`
+under the same per-trial contract as the VAE/classifier steps. With
+``sequence_parallel=True`` the token batch's TIME dimension is sharded
+over the trial's data axis — the long-context regime where one
+sequence exceeds a chip — and the model's ring attention exchanges K/V
+blocks around the submesh ring while GSPMD reduces gradients over the
+same axis. The full sequence length stays resident; only ``T/N`` of it
+lives per chip.
+
+Shift handling keeps shapes static and divisible (ring attention needs
+``T % N == 0``): the model sees all ``T`` tokens, targets are the
+input rolled left by one, and the final position is masked out of the
+loss instead of slicing ``T-1``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from multidisttorch_tpu.parallel.mesh import DATA_AXIS, TrialMesh
+from multidisttorch_tpu.train.steps import TrainState
+
+
+def lm_loss_mean(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Mean next-token cross-entropy; the last position is masked (its
+    target would wrap around the roll)."""
+    targets = jnp.roll(tokens, -1, axis=1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    t = tokens.shape[1]
+    w = (jnp.arange(t) < t - 1).astype(jnp.float32)[None, :]
+    return jnp.sum(nll * w) / jnp.sum(w) / tokens.shape[0]
+
+
+def make_lm_train_step(
+    trial: TrialMesh,
+    model: Any,
+    tx: optax.GradientTransformation,
+    *,
+    sequence_parallel: bool = False,
+    shardings: Any = None,
+) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict]]:
+    """``step(state, tokens) -> (state, {loss})`` — ``tokens`` is
+    ``(B, T) int32``; with ``sequence_parallel`` the T dimension is
+    sharded over the data axis (batch replicated), otherwise B is
+    sharded (plain DP)."""
+    repl = trial.replicated_sharding
+    tokens_sh = (
+        trial.sharding(None, DATA_AXIS)
+        if sequence_parallel
+        else trial.batch_sharding
+    )
+    state_sh = repl if shardings is None else shardings
+
+    def step_fn(state: TrainState, tokens: jax.Array):
+        def loss_fn(params):
+            logits = model.apply({"params": params}, tokens)
+            return lm_loss_mean(logits, tokens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(
+                params=new_params, opt_state=new_opt, step=state.step + 1
+            ),
+            {"loss": loss.astype(jnp.float32)},
+        )
+
+    return jax.jit(
+        step_fn,
+        in_shardings=(state_sh, tokens_sh),
+        out_shardings=(state_sh, repl),
+        donate_argnums=(0,),
+    )
+
+
+def create_lm_state(
+    trial: TrialMesh,
+    model: Any,
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    example_len: int = 8,
+) -> TrainState:
+    params = model.init(
+        {"params": rng}, jnp.zeros((1, example_len), jnp.int32)
+    )["params"]
+    return trial.device_put(
+        TrainState(
+            params=params,
+            opt_state=tx.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+    )

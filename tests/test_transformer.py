@@ -1,0 +1,112 @@
+"""TransformerLM with ring-parallel attention: dense-vs-ring parity and
+sequence-parallel training. The reference has no attention at all
+(SURVEY.md §5); this is the model that makes the long-context op a
+usable capability. 8 virtual CPU devices."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from multidisttorch_tpu.models.transformer import TransformerLM
+from multidisttorch_tpu.ops.ring_attention import make_ring_attention
+from multidisttorch_tpu.parallel.mesh import DATA_AXIS, setup_groups
+from multidisttorch_tpu.train.lm import (
+    create_lm_state,
+    lm_loss_mean,
+    make_lm_train_step,
+)
+
+VOCAB = 17
+
+
+def _models(trial):
+    common = dict(
+        vocab_size=VOCAB, d_model=32, num_heads=2, num_layers=2, max_len=64
+    )
+    dense = TransformerLM(**common)
+    ring = TransformerLM(
+        attention=make_ring_attention(trial, causal=True), **common
+    )
+    return dense, ring
+
+
+def _tokens(b=2, t=32, seed=0):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, VOCAB, (b, t)).astype(np.int32)
+    )
+
+
+def test_ring_lm_forward_matches_dense():
+    (g,) = setup_groups(1)  # 8-device ring over the sequence
+    dense, ring = _models(g)
+    tokens = _tokens()
+    params = dense.init({"params": jax.random.key(0)}, tokens)["params"]
+    logits_dense = dense.apply({"params": params}, tokens)
+    logits_ring = jax.jit(
+        lambda p, tk: ring.apply({"params": p}, tk)
+    )(params, jax.device_put(tokens, g.sharding(None, DATA_AXIS)))
+    np.testing.assert_allclose(
+        np.asarray(logits_ring), np.asarray(logits_dense),
+        rtol=2e-4, atol=2e-5,
+    )
+
+
+def test_ring_lm_grads_match_dense():
+    (g,) = setup_groups(1)
+    dense, ring = _models(g)
+    tokens = _tokens(seed=1)
+    params = dense.init({"params": jax.random.key(0)}, tokens)["params"]
+
+    g_dense = jax.grad(
+        lambda p: lm_loss_mean(dense.apply({"params": p}, tokens), tokens)
+    )(params)
+    tokens_sp = jax.device_put(tokens, g.sharding(None, DATA_AXIS))
+    g_ring = jax.jit(
+        jax.grad(
+            lambda p: lm_loss_mean(
+                ring.apply({"params": p}, tokens_sp), tokens_sp
+            )
+        )
+    )(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=1e-5
+        ),
+        jax.device_get(g_ring),
+        jax.device_get(g_dense),
+    )
+
+
+def test_sequence_parallel_training_learns_pattern():
+    # T=64 sharded over 8 devices (8 tokens per chip): a periodic token
+    # stream is perfectly predictable; SP training must drive the
+    # next-token loss well below random (ln 17 ≈ 2.83).
+    (g,) = setup_groups(1)
+    _, ring = _models(g)
+    tx = optax.adam(3e-3)
+    state = create_lm_state(g, ring, tx, jax.random.key(0), example_len=64)
+    step = make_lm_train_step(g, ring, tx, sequence_parallel=True)
+
+    base = np.tile(np.arange(8), 16)[:64]  # period-8 pattern
+    tokens = jax.device_put(
+        jnp.asarray(np.stack([base, (base + 3) % 8]).astype(np.int32)),
+        g.sharding(None, DATA_AXIS),
+    )
+    losses = []
+    for _ in range(60):
+        state, m = step(state, tokens)
+        losses.append(float(m["loss"]))
+    assert losses[0] > 2.0  # near-random at init
+    assert losses[-1] < 0.7, losses[-1]
+
+
+def test_lm_loss_masks_final_position():
+    # A wrong prediction ONLY at the rolled-around final target must not
+    # change the loss.
+    logits = jnp.zeros((1, 4, VOCAB))
+    tokens = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    spiked = logits.at[0, 3, 5].set(100.0)  # affects only position T-1
+    assert float(lm_loss_mean(logits, tokens)) == float(
+        lm_loss_mean(spiked, tokens)
+    )
