@@ -1,0 +1,93 @@
+"""Long-context LM demo: one sequence sharded across the device group.
+
+The sequence-parallel regime the framework treats as first-class: a
+causal TransformerLM whose attention is exact ring attention
+(``ops/ring_attention.py``) — each device holds ``T/N`` tokens of the
+context, K/V blocks rotate around the submesh ring, and training runs
+as ordinary jitted steps. On 8 virtual CPU devices a T=512 context
+lives 64 tokens per "chip"; the same program on a TPU pod shards real
+long contexts over ICI.
+
+Run:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/lm_long_context.py --seq-len 512 --steps 60
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+import multidisttorch_tpu as mdt  # noqa: E402
+from multidisttorch_tpu.models.transformer import TransformerLM  # noqa: E402
+from multidisttorch_tpu.ops.ring_attention import make_ring_attention  # noqa: E402
+from multidisttorch_tpu.parallel.mesh import DATA_AXIS  # noqa: E402
+from multidisttorch_tpu.train.lm import (  # noqa: E402
+    create_lm_state,
+    make_lm_train_step,
+)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="SP long-context LM demo")
+    parser.add_argument("--seq-len", type=int, default=512)
+    parser.add_argument("--batch-size", type=int, default=2)
+    parser.add_argument("--vocab", type=int, default=32)
+    parser.add_argument("--d-model", type=int, default=64)
+    parser.add_argument("--layers", type=int, default=2)
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--lr", type=float, default=3e-3)
+    args = parser.parse_args()
+
+    mdt.initialize_runtime()
+    (g,) = mdt.setup_groups(1)
+    if args.seq_len % g.size:
+        parser.error(f"--seq-len must divide by {g.size} devices")
+    print(
+        f"ring of {g.size} devices; {args.seq_len} tokens "
+        f"({args.seq_len // g.size} per device)"
+    )
+
+    model = TransformerLM(
+        vocab_size=args.vocab,
+        d_model=args.d_model,
+        num_layers=args.layers,
+        max_len=args.seq_len,
+        attention=make_ring_attention(g, causal=True),
+    )
+    tx = optax.adam(args.lr)
+    state = create_lm_state(g, model, tx, jax.random.key(0),
+                            example_len=args.seq_len)
+    step = make_lm_train_step(g, model, tx, sequence_parallel=True)
+
+    # Periodic corpus: perfectly learnable, so the loss trend is the
+    # whole story.
+    period = 16
+    base = np.tile(np.arange(period), args.seq_len // period + 1)
+    rows = [
+        (base[: args.seq_len] + 2 * r) % args.vocab
+        for r in range(args.batch_size)
+    ]
+    tokens = jax.device_put(
+        jnp.asarray(np.stack(rows).astype(np.int32)),
+        g.sharding(None, DATA_AXIS),
+    )
+
+    t0 = time.time()
+    for i in range(args.steps):
+        state, m = step(state, tokens)
+        if i % 10 == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  next-token loss {float(m['loss']):.4f}")
+    print(f"done in {time.time() - t0:.1f}s "
+          f"(loss should fall well below ln(vocab)={np.log(args.vocab):.2f})")
+
+
+if __name__ == "__main__":
+    main()

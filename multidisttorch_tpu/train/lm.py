@@ -17,7 +17,7 @@ loss instead of slicing ``T-1``.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -86,11 +86,28 @@ def create_lm_state(
     model: Any,
     tx: optax.GradientTransformation,
     rng: jax.Array,
-    example_len: int = 8,
+    example_len: Optional[int] = None,
+    param_shardings: Any = None,
 ) -> TrainState:
+    """Initialize and place an LM state on the trial submesh.
+
+    ``example_len`` shapes the init dummy; for ring-attention models the
+    sequence length must divide the trial's data-axis extent, so the
+    default is ``8 * trial.data_size`` (always divisible; irrelevant to
+    the resulting param shapes). ``param_shardings`` shards weights
+    (e.g. ``parallel.fsdp.fsdp_param_shardings``) via the shared
+    ``train.steps.place_sharded_state`` recipe — same contract as the
+    VAE and classifier state creators.
+    """
+    from multidisttorch_tpu.train.steps import place_sharded_state
+
+    if example_len is None:
+        example_len = 8 * trial.data_size
     params = model.init(
         {"params": rng}, jnp.zeros((1, example_len), jnp.int32)
     )["params"]
+    if param_shardings is not None:
+        return place_sharded_state(trial, params, tx, param_shardings)
     return trial.device_put(
         TrainState(
             params=params,
