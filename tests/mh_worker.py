@@ -246,6 +246,45 @@ def main():
             },
         }
 
+    elif mode == "lm_sp":
+        # Sequence parallelism ACROSS PROCESSES: one 64-token context
+        # sharded over all 8 devices of the 2-process world; ring
+        # attention's K/V blocks cross the process boundary on the
+        # ppermute ring. Both processes must train identically.
+        import numpy as np
+        import optax
+
+        from multidisttorch_tpu.models.transformer import TransformerLM
+        from multidisttorch_tpu.ops.ring_attention import make_ring_attention
+        from multidisttorch_tpu.parallel.mesh import DATA_AXIS, setup_groups
+        from multidisttorch_tpu.train.lm import (
+            create_lm_state,
+            make_lm_train_step,
+        )
+
+        (g,) = setup_groups(1)
+        model = TransformerLM(
+            vocab_size=16, d_model=32, num_heads=2, num_layers=2,
+            max_len=64, attention=make_ring_attention(g, causal=True),
+        )
+        tx = optax.adam(3e-3)
+        state = create_lm_state(g, model, tx, jax.random.key(0),
+                                example_len=64)
+        step = make_lm_train_step(g, model, tx, sequence_parallel=True)
+        base = np.tile(np.arange(8), 8)[:64]
+        tokens_np = np.stack([base, (base + 3) % 8]).astype(np.int32)
+        tokens = g.device_put(tokens_np, g.sharding(None, DATA_AXIS))
+        losses = []
+        for _ in range(25):
+            state, m = step(state, tokens)
+            losses.append(round(float(m["loss"]), 6))
+        summary = {
+            "pid": pid,
+            "first_loss": losses[0],
+            "final_loss": losses[-1],
+            "seq_shard_len": 64 // g.size,
+        }
+
     elif mode == "pbt":
         # Cross-process exploit moves weights via broadcast_one_to_all;
         # every process must report identical global decisions.
