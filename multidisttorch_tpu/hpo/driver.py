@@ -98,6 +98,10 @@ class TrialConfig:
     # trade recompute FLOPs for HBM when the model or the fused-steps
     # scan outgrows device memory. Numerically identical training.
     remat: bool = False
+    # Gradient accumulation: split each batch into this many equal
+    # microbatches, accumulate grads in-step, one optimizer update —
+    # the effective batch size can exceed HBM. Composes with remat.
+    grad_accum: int = 1
 
 
 @dataclass
@@ -233,12 +237,12 @@ class _TrialRun:
         )
         self.train_step = make_train_step(
             trial, model, tx, beta=cfg.beta, remat=cfg.remat,
-            shardings=self._state_sh,
+            grad_accum=cfg.grad_accum, shardings=self._state_sh,
         )
         self.multi_step = (
             make_multi_step(
                 trial, model, tx, beta=cfg.beta, remat=cfg.remat,
-                shardings=self._state_sh,
+                grad_accum=cfg.grad_accum, shardings=self._state_sh,
             )
             if cfg.fused_steps > 1
             else None

@@ -53,28 +53,52 @@ def create_classifier_state(
 
 
 def _build_classifier_step_fn(
-    model: Any, tx: optax.GradientTransformation
+    trial: TrialMesh,
+    model: Any,
+    tx: optax.GradientTransformation,
+    grad_accum: int = 1,
 ) -> Callable:
     """Un-jitted classifier step body, shared by the single-step and
-    scan-fused builders."""
+    scan-fused builders.
+
+    ``grad_accum=A`` accumulates gradients over A equal microbatches
+    (the shared ``train.steps.accumulate_gradients`` recipe — one copy
+    of the scan/constraint logic); the classifier forward is
+    deterministic, so the accumulated gradient equals the full-batch
+    gradient exactly (up to summation order)."""
+    from multidisttorch_tpu.train.steps import accumulate_gradients
+
+    def microbatch(params, images, labels):
+        logits = model.apply({"params": params}, images)
+        loss = softmax_cross_entropy_mean(logits, labels)
+        correct = jnp.sum(
+            (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        )
+        return loss, correct
 
     def step_fn(state: TrainState, images: jax.Array, labels: jax.Array):
-        def loss_fn(params):
-            logits = model.apply({"params": params}, images)
-            return softmax_cross_entropy_mean(logits, labels), logits
+        n = images.shape[0]
+        if grad_accum == 1:
+            (loss, correct), grads = jax.value_and_grad(
+                microbatch, has_aux=True
+            )(state.params, images, labels)
+        else:
+            loss, correct, grads = accumulate_gradients(
+                trial,
+                microbatch,
+                state.params,
+                (images, labels),
+                grad_accum=grad_accum,
+            )
 
-        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params
-        )
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
-        acc = jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
         new_state = TrainState(
             params=new_params, opt_state=new_opt, step=state.step + 1
         )
         return new_state, {
             "loss": loss.astype(jnp.float32),
-            "accuracy": acc,
+            "accuracy": correct / n,
         }
 
     return step_fn
@@ -86,18 +110,23 @@ def make_classifier_train_step(
     tx: optax.GradientTransformation,
     *,
     shardings: Any = None,
+    grad_accum: int = 1,
 ) -> Callable:
     """``step(state, images, labels) -> (state, {loss, accuracy})``.
 
     ``shardings`` (from ``train.steps.state_shardings`` on a
     tensor-parallel state) pins the state layout across steps, same as
-    the VAE step builders.
+    the VAE step builders. ``grad_accum`` accumulates over microbatches
+    (see ``_build_classifier_step_fn``).
     """
+    from multidisttorch_tpu.train.steps import _validate_grad_accum
+
+    _validate_grad_accum(grad_accum)
     repl = trial.replicated_sharding
     data = trial.batch_sharding
     state_sh = repl if shardings is None else shardings
     return jax.jit(
-        _build_classifier_step_fn(model, tx),
+        _build_classifier_step_fn(trial, model, tx, grad_accum),
         in_shardings=(state_sh, data, data),
         out_shardings=(state_sh, repl),
         donate_argnums=(0,),
@@ -110,6 +139,7 @@ def make_classifier_multi_step(
     tx: optax.GradientTransformation,
     *,
     shardings: Any = None,
+    grad_accum: int = 1,
 ) -> Callable:
     """K chained classifier train steps in ONE dispatch (``lax.scan``) —
     the labeled-data analog of ``train.steps.make_multi_step``.
@@ -121,13 +151,16 @@ def make_classifier_multi_step(
     ``shardings`` pins a tensor-parallel state's layout, same as
     :func:`make_classifier_train_step` — without it a TP state would be
     silently resharded to replicated on every fused dispatch.
+    ``grad_accum`` composes with fusion, same as the VAE multi-step.
     """
     from multidisttorch_tpu.parallel.mesh import DATA_AXIS
+    from multidisttorch_tpu.train.steps import _validate_grad_accum
 
+    _validate_grad_accum(grad_accum)
     repl = trial.replicated_sharding
     chunk = trial.sharding(None, DATA_AXIS)
     state_sh = repl if shardings is None else shardings
-    step_fn = _build_classifier_step_fn(model, tx)
+    step_fn = _build_classifier_step_fn(trial, model, tx, grad_accum)
 
     def multi_fn(state: TrainState, images: jax.Array, labels: jax.Array):
         def body(s, xs):

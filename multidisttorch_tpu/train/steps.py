@@ -142,6 +142,78 @@ def state_shardings(state: TrainState) -> TrainState:
     return jax.tree.map(lambda x: x.sharding, state)
 
 
+def accumulate_gradients(
+    trial: TrialMesh,
+    fn: Callable,
+    params: Any,
+    batch_arrays: tuple,
+    per_micro_args: tuple = (),
+    *,
+    grad_accum: int,
+):
+    """The ONE copy of the microbatch gradient-accumulation recipe.
+
+    ``fn(params, *micro_batch_arrays, *micro_extra_args) -> (loss, aux)``
+    is evaluated on ``grad_accum`` equal splits of each batch-major
+    array (dim 0), with gradients, f32 losses, and aux values summed in
+    a ``lax.scan`` carry; returns ``(loss_mean, aux_sum, grads_mean)``.
+    ``per_micro_args`` are already microbatch-major ``(A, ...)`` (e.g.
+    per-microbatch RNG keys). The reshape keeps batch rows sharded over
+    the data axis WITHIN each microbatch — without the constraint GSPMD
+    may shard the microbatch index instead, which parallelizes the scan
+    away and gives up the activation-memory saving.
+    """
+    n = batch_arrays[0].shape[0]
+    if n % grad_accum:
+        raise ValueError(
+            f"batch size {n} not divisible by grad_accum={grad_accum}"
+        )
+    mb = n // grad_accum
+
+    def prep(a):
+        m = a.reshape((grad_accum, mb) + a.shape[1:])
+        return jax.lax.with_sharding_constraint(
+            m, trial.sharding(None, DATA_AXIS, *([None] * (a.ndim - 1)))
+        )
+
+    micro = tuple(prep(a) for a in batch_arrays)
+
+    def body(carry, xs):
+        loss_acc, aux_acc, grad_acc = carry
+        (l, aux), g = jax.value_and_grad(fn, has_aux=True)(params, *xs)
+        return (
+            loss_acc + l.astype(jnp.float32),
+            jax.tree.map(jnp.add, aux_acc, aux),
+            jax.tree.map(jnp.add, grad_acc, g),
+        ), None
+
+    # Abstract eval for the aux zero-carry (shapes/dtypes only, no FLOPs).
+    aux_shape = jax.eval_shape(
+        lambda p, *xs: fn(p, *xs)[1],
+        params,
+        *(m[0] for m in micro),
+        *(x[0] for x in per_micro_args),
+    )
+    zeros = (
+        jnp.zeros((), jnp.float32),
+        jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), aux_shape),
+        jax.tree.map(jnp.zeros_like, params),
+    )
+    (loss_sum, aux_sum, grad_sum), _ = jax.lax.scan(
+        body, zeros, micro + per_micro_args
+    )
+    return (
+        loss_sum / grad_accum,
+        aux_sum,
+        jax.tree.map(lambda g: g / grad_accum, grad_sum),
+    )
+
+
+def _validate_grad_accum(grad_accum: int) -> None:
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+
+
 def _build_step_fn(
     trial: TrialMesh,
     model: VAE,
@@ -149,6 +221,7 @@ def _build_step_fn(
     beta: float,
     use_fused_loss: bool,
     remat: bool = False,
+    grad_accum: int = 1,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
     """The un-jitted train-step body shared by :func:`make_train_step`
     (one step per dispatch) and :func:`make_multi_step` (scan-fused).
@@ -157,6 +230,15 @@ def _build_step_fn(
     are recomputed during the backward pass instead of stored — the
     standard HBM-for-FLOPs trade when a model (or a long scan of fused
     steps) outgrows device memory. Numerically identical training.
+
+    ``grad_accum=A`` splits the batch into A equal microbatches and
+    accumulates their gradients in a ``lax.scan`` before the single
+    optimizer update — activation memory drops to one microbatch's
+    worth, so the effective batch can exceed HBM. The per-sample-mean
+    loss makes the accumulated gradient the mean of microbatch
+    gradients, i.e. the same estimator as the full batch (each
+    microbatch draws its own reparameterization noise, so values match
+    the full-batch program in expectation, not bitwise).
     """
     loss_impl = elbo_loss_sum
     if use_fused_loss:
@@ -193,17 +275,31 @@ def _build_step_fn(
     if remat:
         forward = jax.checkpoint(forward)
 
+    def microbatch_loss(params, mb_batch, mb_rng):
+        m = mb_batch.shape[0]
+        recon_logits, mu, logvar = forward(params, mb_batch, mb_rng)
+        total = loss_impl(
+            recon_logits, mb_batch.reshape(m, -1), mu, logvar, beta
+        )
+        return total / m
+
     def step_fn(state: TrainState, batch: jax.Array, rng: jax.Array):
         n = batch.shape[0]
 
-        def loss_fn(params):
-            recon_logits, mu, logvar = forward(params, batch, rng)
-            total = loss_impl(
-                recon_logits, batch.reshape(n, -1), mu, logvar, beta
+        if grad_accum == 1:
+            loss, grads = jax.value_and_grad(microbatch_loss)(
+                state.params, batch, rng
             )
-            return total / n
+        else:
+            loss, _, grads = accumulate_gradients(
+                trial,
+                lambda p, mb, r: (microbatch_loss(p, mb, r), ()),
+                state.params,
+                (batch,),
+                (jax.random.split(rng, grad_accum),),
+                grad_accum=grad_accum,
+            )
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         new_state = TrainState(
@@ -224,6 +320,7 @@ def make_train_step(
     use_fused_loss: bool = False,
     shardings: Any = None,
     remat: bool = False,
+    grad_accum: int = 1,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
     """Build the compiled train step for one trial submesh.
 
@@ -244,7 +341,10 @@ def make_train_step(
     """
     repl = trial.replicated_sharding
     data = trial.batch_sharding
-    step_fn = _build_step_fn(trial, model, tx, beta, use_fused_loss, remat)
+    _validate_grad_accum(grad_accum)
+    step_fn = _build_step_fn(
+        trial, model, tx, beta, use_fused_loss, remat, grad_accum
+    )
     state_sh = repl if shardings is None else shardings
     return jax.jit(
         step_fn,
@@ -263,6 +363,7 @@ def make_multi_step(
     use_fused_loss: bool = False,
     shardings: Any = None,
     remat: bool = False,
+    grad_accum: int = 1,
 ) -> Callable[[TrainState, jax.Array, jax.Array], tuple[TrainState, dict]]:
     """K chained train steps in ONE dispatch, via ``lax.scan``.
 
@@ -281,7 +382,10 @@ def make_multi_step(
     :func:`make_train_step`). ``rng`` is split into K per-step keys
     inside the compiled program.
     """
-    step_fn = _build_step_fn(trial, model, tx, beta, use_fused_loss, remat)
+    _validate_grad_accum(grad_accum)
+    step_fn = _build_step_fn(
+        trial, model, tx, beta, use_fused_loss, remat, grad_accum
+    )
     repl = trial.replicated_sharding
     batches_sh = trial.sharding(None, DATA_AXIS)
     state_sh = repl if shardings is None else shardings
