@@ -38,6 +38,19 @@ def lm_loss_mean(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     return jnp.sum(nll * w) / jnp.sum(w) / tokens.shape[0]
 
 
+def _lm_shardings(trial: TrialMesh, sequence_parallel: bool, shardings):
+    """The one copy of the LM input/state sharding contract shared by
+    the train and eval step builders: tokens shard T over the data axis
+    under sequence parallelism (batch replicated), else B (plain DP)."""
+    repl = trial.replicated_sharding
+    tokens_sh = (
+        trial.sharding(None, DATA_AXIS)
+        if sequence_parallel
+        else trial.batch_sharding
+    )
+    return repl, tokens_sh, (repl if shardings is None else shardings)
+
+
 def make_lm_train_step(
     trial: TrialMesh,
     model: Any,
@@ -50,13 +63,9 @@ def make_lm_train_step(
     ``(B, T) int32``; with ``sequence_parallel`` the T dimension is
     sharded over the data axis (batch replicated), otherwise B is
     sharded (plain DP)."""
-    repl = trial.replicated_sharding
-    tokens_sh = (
-        trial.sharding(None, DATA_AXIS)
-        if sequence_parallel
-        else trial.batch_sharding
+    repl, tokens_sh, state_sh = _lm_shardings(
+        trial, sequence_parallel, shardings
     )
-    state_sh = repl if shardings is None else shardings
 
     def step_fn(state: TrainState, tokens: jax.Array):
         def loss_fn(params):
@@ -78,6 +87,34 @@ def make_lm_train_step(
         in_shardings=(state_sh, tokens_sh),
         out_shardings=(state_sh, repl),
         donate_argnums=(0,),
+    )
+
+
+def make_lm_eval_step(
+    trial: TrialMesh,
+    model: Any,
+    *,
+    sequence_parallel: bool = False,
+    shardings: Any = None,
+) -> Callable[[TrainState, jax.Array], dict]:
+    """``eval(state, tokens) -> {loss, perplexity}`` — same next-token
+    objective and token sharding contract as :func:`make_lm_train_step`,
+    no gradient."""
+    repl, tokens_sh, state_sh = _lm_shardings(
+        trial, sequence_parallel, shardings
+    )
+
+    def eval_fn(state: TrainState, tokens: jax.Array):
+        loss = lm_loss_mean(
+            model.apply({"params": state.params}, tokens), tokens
+        )
+        return {
+            "loss": loss.astype(jnp.float32),
+            "perplexity": jnp.exp(loss).astype(jnp.float32),
+        }
+
+    return jax.jit(
+        eval_fn, in_shardings=(state_sh, tokens_sh), out_shardings=repl
     )
 
 

@@ -110,3 +110,22 @@ def test_lm_loss_masks_final_position():
     assert float(lm_loss_mean(logits, tokens)) == float(
         lm_loss_mean(spiked, tokens)
     )
+
+
+def test_lm_eval_step_matches_train_objective():
+    from multidisttorch_tpu.train.lm import make_lm_eval_step
+
+    (g,) = setup_groups(1)
+    _, ring = _models(g)
+    tx = optax.adam(1e-3)
+    state = create_lm_state(g, ring, tx, jax.random.key(0), example_len=32)
+    tokens = jax.device_put(_tokens(), g.sharding(None, DATA_AXIS))
+    ev = make_lm_eval_step(g, ring, sequence_parallel=True)
+    out = ev(state, tokens)
+    manual = float(
+        lm_loss_mean(ring.apply({"params": state.params}, tokens), tokens)
+    )
+    np.testing.assert_allclose(float(out["loss"]), manual, rtol=1e-6)
+    np.testing.assert_allclose(
+        float(out["perplexity"]), np.exp(manual), rtol=1e-5
+    )
