@@ -43,10 +43,14 @@ CHUNK_STEPS = 100  # inner lax.scan steps per dispatch (make_multi_step)
 MEASURE_CHUNKS = 10
 TORCH_MEASURE_STEPS = 30
 
-PREFLIGHT_TIMEOUT_S = 150  # first TPU init is ~20-40s healthy; a wedged
-# plugin blocks forever (round 1: rc=124 after 9 min) — cap it here.
-RETRY_DELAY_S = int(os.environ.get("MDT_BENCH_RETRY_DELAY_S", "45"))
-RETRY_TIMEOUT_S = 90  # transient wedges clear in seconds; a retry that
+PREFLIGHT_TIMEOUT_S = 120  # first TPU init is ~20-40s healthy; a wedged
+# plugin blocks forever (round 1: rc=124 after 9 min; rounds 2-4: every
+# probe blocked >150s) — cap it well past healthy-init time. The whole
+# probe+triage+retry budget must stay small enough that a wedged machine
+# still finishes the CPU-fallback bench inside the driver's own timeout:
+# losing the artifact to a timeout is worse than a shorter probe.
+RETRY_DELAY_S = int(os.environ.get("MDT_BENCH_RETRY_DELAY_S", "30"))
+RETRY_TIMEOUT_S = 60  # transient wedges clear in seconds; a retry that
 # still blocks this long is the same wedge, not a slow init.
 
 
