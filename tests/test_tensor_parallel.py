@@ -268,3 +268,38 @@ def test_tp_state_layout_is_stable_across_steps():
             lambda a, s: a.sharding == s, state.params, sh.params
         )
     )
+
+
+def test_resnet_tp_with_grad_accum_matches_full_batch():
+    # Composition: TP weight sharding x microbatch gradient accumulation
+    # — deterministic classifier, so one accumulated step equals one
+    # full-batch step exactly on the same TP submesh.
+    (g,) = setup_groups(1, model_parallel=2)
+    model = ResNet(stage_sizes=(1,), base_channels=8, image_hw=16)
+    tx = optax.adam(1e-3)
+    rng = np.random.default_rng(4)
+    images = jax.device_put(
+        jnp.asarray(rng.uniform(0, 1, (16, 16 * 16 * 3)).astype(np.float32)),
+        g.batch_sharding,
+    )
+    labels = jax.device_put(
+        jnp.asarray(rng.integers(0, 10, (16,)).astype(np.int32)),
+        g.batch_sharding,
+    )
+    outs = {}
+    for accum in (1, 4):
+        state = create_classifier_state(
+            g, model, tx, jax.random.key(0),
+            param_shardings=resnet_tp_shardings(g, model),
+        )
+        step = make_classifier_train_step(
+            g, model, tx, shardings=state_shardings(state), grad_accum=accum
+        )
+        state, m = step(state, images, labels)
+        outs[accum] = (jax.device_get(state.params), float(m["loss"]))
+    assert outs[1][1] == pytest.approx(outs[4][1], rel=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        outs[1][0],
+        outs[4][0],
+    )
