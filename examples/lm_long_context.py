@@ -44,6 +44,11 @@ def main():
     parser.add_argument("--layers", type=int, default=2)
     parser.add_argument("--steps", type=int, default=60)
     parser.add_argument("--lr", type=float, default=3e-3)
+    parser.add_argument(
+        "--remat", action="store_true",
+        help="per-block activation rematerialization (the long-context "
+        "HBM lever: only block-boundary residuals are stored)",
+    )
     args = parser.parse_args()
 
     mdt.initialize_runtime()
@@ -61,6 +66,7 @@ def main():
         num_layers=args.layers,
         max_len=args.seq_len,
         attention=make_ring_attention(g, causal=True),
+        remat=args.remat,
     )
     tx = optax.adam(args.lr)
     state = create_lm_state(g, model, tx, jax.random.key(0),

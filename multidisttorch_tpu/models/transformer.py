@@ -74,6 +74,14 @@ class TransformerLM(nn.Module):
     max_len: int = 256
     attention: Optional[Callable] = None
     dtype: Any = jnp.float32
+    # Per-BLOCK rematerialization (flax nn.remat): only the block
+    # boundaries' residual streams are saved; each block's internal
+    # activations (qkv, attention probs, the 4x MLP) are recomputed in
+    # the backward pass. This is the placement that actually cuts peak
+    # HBM for a deep stack — checkpointing the whole forward would
+    # leave every layer's activations live during the backward and
+    # save nothing.
+    remat: bool = False
 
     @nn.compact
     def __call__(self, tokens):
@@ -98,8 +106,9 @@ class TransformerLM(nn.Module):
             param_dtype=jnp.float32, name="pos_embed",
         )(jnp.arange(t)[None, :])
         x = x + pos
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.num_layers):
-            x = Block(
+            x = block_cls(
                 d_model=self.d_model,
                 num_heads=self.num_heads,
                 attention=attn,

@@ -62,15 +62,20 @@ def make_lm_train_step(
     """``step(state, tokens) -> (state, {loss})`` — ``tokens`` is
     ``(B, T) int32``; with ``sequence_parallel`` the T dimension is
     sharded over the data axis (batch replicated), otherwise B is
-    sharded (plain DP)."""
+    sharded (plain DP). For activation rematerialization construct the
+    model with ``TransformerLM(remat=True)`` — per-BLOCK checkpointing,
+    the placement that actually cuts peak HBM (a whole-forward
+    ``jax.checkpoint`` here would recompute everything and save
+    nothing)."""
     repl, tokens_sh, state_sh = _lm_shardings(
         trial, sequence_parallel, shardings
     )
 
     def step_fn(state: TrainState, tokens: jax.Array):
         def loss_fn(params):
-            logits = model.apply({"params": params}, tokens)
-            return lm_loss_mean(logits, tokens)
+            return lm_loss_mean(
+                model.apply({"params": params}, tokens), tokens
+            )
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
