@@ -5,5 +5,8 @@ from multidisttorch_tpu.models.resnet import (
     ResNet18,
     resnet_tp_shardings,
 )
-from multidisttorch_tpu.models.transformer import TransformerLM
+from multidisttorch_tpu.models.transformer import (
+    TransformerLM,
+    transformer_tp_shardings,
+)
 from multidisttorch_tpu.models.vae import VAE, init_vae_params, vae_tp_shardings

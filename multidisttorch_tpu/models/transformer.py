@@ -22,6 +22,7 @@ from __future__ import annotations
 from typing import Any, Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from multidisttorch_tpu.ops.ring_attention import dense_attention_reference
@@ -122,3 +123,56 @@ class TransformerLM(nn.Module):
             self.vocab_size, dtype=jnp.float32, param_dtype=jnp.float32,
             name="head",
         )(x)
+
+
+def transformer_tp_shardings(trial, model: TransformerLM):
+    """Megatron-style tensor-parallel shardings for the LM's MLP blocks.
+
+    Each block's 4x MLP is the classic column/row pair — ``up``
+    column-parallel (output features sharded over the ``model`` axis),
+    ``down`` row-parallel (input features sharded; GSPMD closes the
+    pair with one psum) — which is where 2/3 of a transformer block's
+    parameters live. Attention projections, embeddings, norms, and the
+    head stay replicated (attention-head sharding composes with the
+    ring's sequence axis but is a different recipe; the MLP pair is the
+    exact, always-applicable one). Requires ``4*d_model`` divisible by
+    the model-axis extent.
+    """
+    from multidisttorch_tpu.parallel.mesh import MODEL_AXIS
+
+    m = trial.model_size
+    if (4 * model.d_model) % m:
+        raise ValueError(
+            f"4*d_model={4 * model.d_model} not divisible by the model "
+            f"axis ({m})"
+        )
+    col = {
+        "kernel": trial.sharding(None, MODEL_AXIS),
+        "bias": trial.sharding(MODEL_AXIS),
+    }
+    row = {
+        "kernel": trial.sharding(MODEL_AXIS, None),
+        "bias": trial.sharding(),
+    }
+    repl = trial.sharding()
+
+    # Dummy length must divide the trial's data-axis extent or a
+    # ring-attention model's shard_map fails inside eval_shape (same
+    # constraint create_lm_state solves the same way).
+    dummy_len = min(8 * trial.data_size, model.max_len)
+    shapes = jax.eval_shape(
+        model.init,
+        {"params": jax.random.key(0)},
+        jnp.zeros((1, dummy_len), jnp.int32),
+    )["params"]
+
+    def rule(path, _leaf):
+        keys = [p.key for p in path if hasattr(p, "key")]
+        if keys and keys[0].startswith("block_"):
+            if keys[1] == "up":
+                return col["kernel"] if keys[-1] == "kernel" else col["bias"]
+            if keys[1] == "down":
+                return row["kernel"] if keys[-1] == "kernel" else row["bias"]
+        return repl
+
+    return jax.tree_util.tree_map_with_path(rule, shapes)
