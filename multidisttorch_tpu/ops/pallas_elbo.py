@@ -47,11 +47,19 @@ def _interpret() -> bool:
 _VMEM_BUDGET_BYTES = 4 * 2**20
 
 
-def _block_rows(batch: int, d: int, latent: int) -> int:
+def _block_rows(logits, x, mu, logvar) -> int:
     """Largest divisor of ``batch`` whose 7-buffer working set fits the
     VMEM budget (whole rows only: the feature dims stay unsplit, so the
-    reduction needs no cross-column accumulator)."""
-    per_row = 4 * (4 * d + 3 * latent)  # f32: l,x,dl dL blocks + mu/lv/dmu/dlv
+    reduction needs no cross-column accumulator). Sized from the actual
+    operand dtypes — bf16 blocks are half the bytes of f32, so the bf16
+    train path gets twice the rows per grid step."""
+    batch, d = logits.shape
+    latent = mu.shape[1]
+    # Worst-case resident set (the bwd pass): logits, x, dlogits wide;
+    # mu, logvar, dmu, dlogvar narrow — outputs at their primal's dtype.
+    per_row = d * (2 * logits.dtype.itemsize + x.dtype.itemsize) + latent * 2 * (
+        mu.dtype.itemsize + logvar.dtype.itemsize
+    )
     target = max(1, _VMEM_BUDGET_BYTES // per_row)
     if batch <= target:
         return batch
@@ -62,14 +70,16 @@ def _block_rows(batch: int, d: int, latent: int) -> int:
 
 
 def _fwd_kernel(logits_ref, x_ref, mu_ref, logvar_ref, out_ref, *, beta):
-    l = logits_ref[:]
-    x = x_ref[:]
+    # Blocks stream in at their storage dtype (bf16 on the TPU train
+    # path — half the HBM bytes of f32); the reduction itself is f32.
+    l = logits_ref[:].astype(jnp.float32)
+    x = x_ref[:].astype(jnp.float32)
     # stable BCE from logits: max(l,0) - l*x + log1p(exp(-|l|))
     bce = jnp.sum(
         jnp.maximum(l, 0.0) - l * x + jnp.log1p(jnp.exp(-jnp.abs(l)))
     )
-    mu = mu_ref[:]
-    logvar = logvar_ref[:]
+    mu = mu_ref[:].astype(jnp.float32)
+    logvar = logvar_ref[:].astype(jnp.float32)
     kl = -0.5 * jnp.sum(1.0 + logvar - mu * mu - jnp.exp(logvar))
     part = bce + beta * kl
 
@@ -86,10 +96,15 @@ def _fwd_kernel(logits_ref, x_ref, mu_ref, logvar_ref, out_ref, *, beta):
 
 def _bwd_kernel(logits_ref, x_ref, mu_ref, logvar_ref,
                 dlogits_ref, dmu_ref, dlogvar_ref, *, beta):
-    l = logits_ref[:]
-    dlogits_ref[:] = jax.nn.sigmoid(l) - x_ref[:]
-    dmu_ref[:] = beta * mu_ref[:]
-    dlogvar_ref[:] = beta * 0.5 * (jnp.exp(logvar_ref[:]) - 1.0)
+    # f32 math, outputs stored back at each cotangent's own dtype
+    # (= its primal's dtype, per custom_vjp's contract).
+    l = logits_ref[:].astype(jnp.float32)
+    x = x_ref[:].astype(jnp.float32)
+    dlogits_ref[:] = (jax.nn.sigmoid(l) - x).astype(dlogits_ref.dtype)
+    dmu_ref[:] = (beta * mu_ref[:].astype(jnp.float32)).astype(dmu_ref.dtype)
+    dlogvar_ref[:] = (
+        beta * 0.5 * (jnp.exp(logvar_ref[:].astype(jnp.float32)) - 1.0)
+    ).astype(dlogvar_ref.dtype)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(4,))
@@ -97,8 +112,10 @@ def fused_elbo_loss_sum(logits, x, mu, logvar, beta=1.0):
     """Summed negative ELBO, fused in a single Pallas kernel.
 
     Drop-in for :func:`ops.losses.elbo_loss_sum` (same semantics as the
-    reference loss at beta=1). Arrays must be float32 2-D ``(batch, D)``
-    / ``(batch, latent)``.
+    reference loss at beta=1). Arrays are 2-D ``(batch, D)`` /
+    ``(batch, latent)`` in any float dtype (mixed ok — the TPU train
+    path feeds bf16 activations with f32 targets); reduction math is
+    always f32, gradients come back in each primal's own dtype.
     """
     return _fwd(logits, x, mu, logvar, beta)[0]
 
@@ -106,7 +123,7 @@ def fused_elbo_loss_sum(logits, x, mu, logvar, beta=1.0):
 def _fwd(logits, x, mu, logvar, beta):
     b, d = logits.shape
     lat = mu.shape[1]
-    bb = _block_rows(b, d, lat)
+    bb = _block_rows(logits, x, mu, logvar)
     out = pl.pallas_call(
         partial(_fwd_kernel, beta=beta),
         grid=(b // bb,),
@@ -129,7 +146,7 @@ def _bwd(beta, residuals, g):
     logits, x, mu, logvar = residuals
     b, d = logits.shape
     lat = mu.shape[1]
-    bb = _block_rows(b, d, lat)
+    bb = _block_rows(logits, x, mu, logvar)
     wide = lambda: pl.BlockSpec(
         (bb, d), lambda i: (i, 0), memory_space=pltpu.VMEM
     )
@@ -140,9 +157,9 @@ def _bwd(beta, residuals, g):
         partial(_bwd_kernel, beta=beta),
         grid=(b // bb,),
         out_shape=(
-            jax.ShapeDtypeStruct(logits.shape, jnp.float32),
-            jax.ShapeDtypeStruct(mu.shape, jnp.float32),
-            jax.ShapeDtypeStruct(logvar.shape, jnp.float32),
+            jax.ShapeDtypeStruct(logits.shape, logits.dtype),
+            jax.ShapeDtypeStruct(mu.shape, mu.dtype),
+            jax.ShapeDtypeStruct(logvar.shape, logvar.dtype),
         ),
         in_specs=[wide(), wide(), narrow(), narrow()],
         out_specs=(wide(), narrow(), narrow()),
@@ -150,7 +167,13 @@ def _bwd(beta, residuals, g):
     )(logits, x, mu, logvar)
     # x is data: propagate its true cotangent (-logits * g) for
     # completeness even though training never differentiates w.r.t. it.
-    return (g * dlogits, g * (-logits), g * dmu, g * dlogvar)
+    # Cotangent dtypes must equal primal dtypes (custom_vjp contract).
+    return (
+        (g * dlogits).astype(logits.dtype),
+        (g * (-logits)).astype(x.dtype),
+        (g * dmu).astype(mu.dtype),
+        (g * dlogvar).astype(logvar.dtype),
+    )
 
 
 fused_elbo_loss_sum.defvjp(_fwd, _bwd)

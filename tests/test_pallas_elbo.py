@@ -59,11 +59,11 @@ def test_multi_block_grid_matches_plain(monkeypatch):
     monkeypatch.setattr(pallas_elbo, "_VMEM_BUDGET_BYTES", 64 * 1024)
     rng = np.random.default_rng(7)
     b, d, lat = 96, 784, 20
-    assert pallas_elbo._block_rows(b, d, lat) < b  # grid really > 1
     logits = jnp.asarray(rng.normal(0, 2, (b, d)).astype(np.float32))
     x = jnp.asarray(rng.uniform(0, 1, (b, d)).astype(np.float32))
     mu = jnp.asarray(rng.normal(0, 1, (b, lat)).astype(np.float32))
     logvar = jnp.asarray(rng.normal(0, 0.5, (b, lat)).astype(np.float32))
+    assert pallas_elbo._block_rows(logits, x, mu, logvar) < b  # grid > 1
 
     fused = float(fused_elbo_loss_sum(logits, x, mu, logvar, 1.5))
     plain = float(elbo_loss_sum(logits, x, mu, logvar, 1.5))
@@ -86,8 +86,56 @@ def test_block_rows_divides_batch():
     from multidisttorch_tpu.ops.pallas_elbo import _block_rows
 
     for batch in (1, 7, 96, 128, 10000):
-        bb = _block_rows(batch, 784, 20)
-        assert 1 <= bb <= batch and batch % bb == 0
+        for dt in (jnp.float32, jnp.bfloat16):
+            args = (
+                jnp.zeros((batch, 784), dt),
+                jnp.zeros((batch, 784), jnp.float32),
+                jnp.zeros((batch, 20), dt),
+                jnp.zeros((batch, 20), dt),
+            )
+            bb = _block_rows(*args)
+            assert 1 <= bb <= batch and batch % bb == 0
+    # bf16 operands halve the bytes per row -> at least as many rows
+    # per grid step as f32 under the same VMEM budget.
+    f32 = (jnp.zeros((10000, 784)), jnp.zeros((10000, 784)),
+           jnp.zeros((10000, 20)), jnp.zeros((10000, 20)))
+    b16 = tuple(a.astype(jnp.bfloat16) for a in f32[:1]) + (f32[1],) + tuple(
+        a.astype(jnp.bfloat16) for a in f32[2:]
+    )
+    assert _block_rows(*b16) >= _block_rows(*f32)
+
+
+def test_bf16_inputs_match_plain(arrays):
+    # The TPU train path feeds bf16 activations (logits/mu/logvar) with
+    # f32 targets; the first real-TPU bench run crashed on exactly this
+    # mix ("Invalid dtype for `swap`: f32 ref, bf16 value"). The kernel
+    # must accept mixed dtypes, reduce in f32, and hand back cotangents
+    # in each primal's own dtype.
+    logits, x, mu, logvar = arrays
+    lb, mb, vb = (a.astype(jnp.bfloat16) for a in (logits, mu, logvar))
+
+    fused = float(fused_elbo_loss_sum(lb, x, mb, vb, 1.0))
+    plain = float(
+        elbo_loss_sum(
+            lb.astype(jnp.float32), x,
+            mb.astype(jnp.float32), vb.astype(jnp.float32), 1.0,
+        )
+    )
+    assert fused == pytest.approx(plain, rel=1e-5)
+
+    g_fused = jax.grad(
+        lambda l, m, lv: fused_elbo_loss_sum(l, x, m, lv, 1.0),
+        argnums=(0, 1, 2),
+    )(lb, mb, vb)
+    g_plain = jax.grad(
+        lambda l, m, lv: elbo_loss_sum(l, x, m, lv, 1.0), argnums=(0, 1, 2)
+    )(logits, mu, logvar)
+    for got, ref, primal in zip(g_fused, g_plain, (lb, mb, vb)):
+        assert got.dtype == primal.dtype
+        np.testing.assert_allclose(
+            np.asarray(got, dtype=np.float32), np.asarray(ref),
+            rtol=2e-2, atol=2e-2,  # bf16 storage precision
+        )
 
 
 def test_works_under_jit_and_scaling(arrays):
