@@ -41,6 +41,10 @@ BATCH = 128
 HIDDEN, LATENT = 400, 20
 CHUNK_STEPS = 100  # inner lax.scan steps per dispatch (make_multi_step)
 MEASURE_CHUNKS = 10
+MEASURE_REPEATS = 3  # timed passes per number; report the median. The
+# chip is reached through a tunnel with ~2x run-to-run throughput
+# variance (round 4: 6.5M vs 12.7M on the identical program) — one
+# pass is a coin flip, the median of three is a defensible number.
 TORCH_MEASURE_STEPS = 30
 
 PREFLIGHT_TIMEOUT_S = 120  # first TPU init is ~20-40s healthy; a wedged
@@ -360,8 +364,10 @@ def _timed_chunks(trial, model, tx, **step_kwargs) -> float:
     """The one measurement protocol: scan-fused dispatch (CHUNK_STEPS
     optimizer updates per host round-trip — the TPU-idiomatic shape of
     the reference's per-batch loop, vae-hpo.py:67-74), one warmup
-    compile, MEASURE_CHUNKS timed chunks. Returns samples/sec (whole
-    submesh). Both single-trial throughput modes (the headline number
+    compile, then MEASURE_REPEATS passes of MEASURE_CHUNKS timed chunks.
+    Returns the MEDIAN pass's samples/sec (whole submesh) — the tunnel
+    to the chip has ~2x run-to-run variance, so single-pass numbers
+    aren't defensible. Both single-trial throughput modes (the headline number
     and the fused-loss comparison that decides defaults against it) go
     through here so those two can't drift; bench_concurrency and
     bench_to_elbo measure deliberately different things (interleaved
@@ -381,12 +387,17 @@ def _timed_chunks(trial, model, tx, **step_kwargs) -> float:
     key = jax.random.key(1)
     state, _ = multi(state, batches, key)  # compile + warmup
     jax.block_until_ready(state.params)
-    t0 = time.perf_counter()
-    for i in range(MEASURE_CHUNKS):
-        state, _ = multi(state, batches, jax.random.fold_in(key, i))
-    jax.block_until_ready(state.params)
-    dt = time.perf_counter() - t0
-    return MEASURE_CHUNKS * CHUNK_STEPS * BATCH / dt
+    rates = []
+    for r in range(MEASURE_REPEATS):
+        t0 = time.perf_counter()
+        for i in range(MEASURE_CHUNKS):
+            state, _ = multi(
+                state, batches, jax.random.fold_in(key, r * MEASURE_CHUNKS + i)
+            )
+        jax.block_until_ready(state.params)
+        dt = time.perf_counter() - t0
+        rates.append(MEASURE_CHUNKS * CHUNK_STEPS * BATCH / dt)
+    return float(np.median(rates))
 
 
 def bench_ours() -> float:
@@ -417,6 +428,156 @@ def bench_fused_loss_comparison() -> dict:
         > out["xla_loss_samples_per_sec"]
         else "xla"
     )
+    return out
+
+
+# LM bench shape: sized so one TPU v5e chip (16 GB HBM) is comfortably
+# matmul-dominated — the MFU story the tiny flagship VAE cannot tell
+# (its 784x400 matmuls are dispatch/bandwidth-bound by construction).
+LM_VOCAB, LM_DMODEL, LM_HEADS, LM_LAYERS = 32768, 512, 8, 8
+LM_SEQ, LM_BATCH, LM_STEPS = 512, 16, 20
+
+
+def _lm_train_flops_per_token(
+    d: int = LM_DMODEL, layers: int = LM_LAYERS, t: int = LM_SEQ,
+    vocab: int = LM_VOCAB,
+) -> float:
+    """Analytic matmul FLOPs for one LM optimizer step, per token.
+
+    Forward per token: 24·d² per layer (q,k,v,out projections = 8·d²
+    FLOPs, MLP up+down at 4x width = 16·d²) + causal attention
+    2·T·d (QKᵀ + AV at 4·T·d, halved by the causal mask) + the
+    d·vocab head (2·d·V). Train ≈ 3x forward (same dense-stack
+    argument as :func:`_train_flops_per_sample`); embedding lookups
+    are gathers, not FLOPs.
+    """
+    fwd = layers * (24.0 * d * d + 2.0 * t * d) + 2.0 * d * vocab
+    return 3.0 * fwd
+
+
+def bench_lm() -> dict:
+    """Transformer-LM training throughput + MFU on one chip.
+
+    The flagship VAE matches the reference workload but its matmuls are
+    too small to exercise the MXU; this is the framework's own
+    MXU-bound headline (the TransformerLM that also drives the
+    ring-attention long-context path). bf16 compute, f32 params, plain
+    single-submesh training, median of MEASURE_REPEATS timed passes.
+    On TPU, both attention paths are timed — XLA's dense softmax vs the
+    Pallas flash kernel (ops/pallas_attention.py) — and the headline is
+    the winner; the per-variant rates stay in the artifact as the
+    kernel's keep-or-cut decision data.
+    """
+    from multidisttorch_tpu.models.transformer import TransformerLM
+    from multidisttorch_tpu.ops.pallas_attention import make_flash_attention
+    from multidisttorch_tpu.parallel.mesh import setup_groups
+    from multidisttorch_tpu.train.lm import create_lm_state, make_lm_train_step
+
+    (trial,) = setup_groups(1)
+    on_tpu = jax.default_backend() == "tpu"
+    dtype = jnp.bfloat16 if on_tpu else jnp.float32
+    tx = optax.adam(1e-3)
+    tokens = jax.device_put(
+        jnp.asarray(
+            np.random.default_rng(0).integers(
+                0, LM_VOCAB, (LM_BATCH, LM_SEQ), dtype=np.int32
+            )
+        ),
+        trial.batch_sharding,
+    )
+
+    def timed(attention) -> tuple[float, list, float]:
+        model = TransformerLM(
+            vocab_size=LM_VOCAB, d_model=LM_DMODEL, num_heads=LM_HEADS,
+            num_layers=LM_LAYERS, max_len=LM_SEQ, dtype=dtype,
+            attention=attention,
+        )
+        state = create_lm_state(
+            trial, model, tx, jax.random.key(0), example_len=LM_SEQ
+        )
+        step = make_lm_train_step(trial, model, tx)
+        state, _ = step(state, tokens)  # compile + warmup
+        jax.block_until_ready(state.params)
+        rates = []
+        for _ in range(MEASURE_REPEATS):
+            t0 = time.perf_counter()
+            for _ in range(LM_STEPS):
+                state, metrics = step(state, tokens)
+            jax.block_until_ready(state.params)
+            rates.append(
+                LM_STEPS * LM_BATCH * LM_SEQ / (time.perf_counter() - t0)
+            )
+        return float(np.median(rates)), rates, float(metrics["loss"])
+
+    variants = {"dense_xla": timed(None)}
+    flash_error = None
+    if on_tpu:  # interpret-mode flash timings are meaningless off-TPU
+        try:
+            variants["flash_pallas"] = timed(make_flash_attention(causal=True))
+        except Exception as e:
+            # A kernel failure must not discard the dense result already
+            # banked in this one-shot chip window (the round-4 ELBO
+            # kernel failed exactly this way on its first hardware run).
+            flash_error = repr(e)[:300]
+    winner = max(variants, key=lambda k: variants[k][0])
+    tok_s, rates, final_loss = variants[winner]
+
+    ndev = len(jax.devices())
+    flops = _lm_train_flops_per_token()
+    d0 = jax.devices()[0]
+    peak = _peak_flops_per_chip(d0.device_kind) if on_tpu else None
+    return {
+        "tokens_per_sec_per_chip": round(tok_s / ndev, 1),
+        "attention_winner": winner,
+        "variants": {
+            **{
+                k: {"tokens_per_sec": round(v[0], 1),
+                    "pass_rates": [round(r, 1) for r in v[1]]}
+                for k, v in variants.items()
+            },
+            **({"flash_pallas": {"error": flash_error}}
+               if flash_error else {}),
+        },
+        "train_flops_per_token": flops,
+        "mfu": round(tok_s / ndev * flops / peak, 5) if peak else None,
+        "config": {
+            "vocab": LM_VOCAB, "d_model": LM_DMODEL, "heads": LM_HEADS,
+            "layers": LM_LAYERS, "seq_len": LM_SEQ, "batch": LM_BATCH,
+        },
+        "final_loss": final_loss,
+    }
+
+
+def bench_suite() -> dict:
+    """Every measurement in ONE process, for one-shot chip windows.
+
+    The machine's chip is intermittently available and rapid back-to-back
+    processes re-wedge it (round-4 finding), so the way to bank a full
+    set of hardware numbers is a single process that captures everything
+    while it holds the tunnel. Each sub-bench is independent: a failure
+    records its error and the rest still run.
+    """
+    on_tpu = jax.default_backend() == "tpu"
+    out = {}
+    for name, fn in (
+        ("flagship", lambda: {"samples_per_sec_per_chip": round(bench_ours(), 1)}),
+        # Interpret-mode Pallas timings are meaningless and very slow —
+        # same off-TPU gate as the default mode's comparison.
+        ("fused_loss_comparison", bench_fused_loss_comparison if on_tpu
+         else (lambda: {"skipped": "interpret-mode timings meaningless"})),
+        # Full-size LM on a CPU fallback is hours of wall-clock; the
+        # suite must always finish inside the driver's budget.
+        ("lm", bench_lm if on_tpu
+         else (lambda: {"skipped": "full-size LM needs the TPU"})),
+        ("to_elbo_150", lambda: bench_to_elbo(150.0)),
+        ("loader", bench_loader),
+    ):
+        t0 = time.perf_counter()
+        try:
+            out[name] = fn()
+        except Exception as e:  # record, keep banking the rest
+            out[name] = {"error": repr(e)[:300]}
+        out[name]["wall_s"] = round(time.perf_counter() - t0, 1)
     return out
 
 
@@ -729,16 +890,63 @@ def main():
         help="measure host batch-assembly throughput: native C++ "
         "gatherer vs pure numpy",
     )
+    parser.add_argument(
+        "--lm", action="store_true",
+        help="measure Transformer-LM training tokens/sec/chip + MFU "
+        "(the MXU-bound headline the tiny VAE cannot provide)",
+    )
+    parser.add_argument(
+        "--suite", action="store_true",
+        help="bank every measurement (flagship, fused-loss comparison, "
+        "LM, to-elbo, loader) in one process — for one-shot windows on "
+        "the intermittently-available chip",
+    )
     args = parser.parse_args()
 
     if sum(x is not None and x is not False
-           for x in (args.concurrency, args.to_elbo, args.loader)) > 1:
-        parser.error("--concurrency/--to-elbo/--loader are mutually exclusive")
+           for x in (args.concurrency, args.to_elbo, args.loader,
+                     args.lm, args.suite)) > 1:
+        parser.error("--concurrency/--to-elbo/--loader/--lm/--suite are "
+                     "mutually exclusive")
 
     # Every mode goes through the preflight first: the train_loop loader
     # condition (and all training modes) touch jax.devices(), which on a
     # wedged-TPU machine blocks forever without the probe + CPU fallback.
     backend = _ensure_backend()
+
+    if args.suite:
+        r = bench_suite()
+        r["backend"] = backend
+        flagship = r.get("flagship", {}).get("samples_per_sec_per_chip")
+        print(
+            json.dumps(
+                {
+                    "metric": "vae_train_samples_per_sec_per_chip",
+                    "value": flagship,
+                    "unit": "samples/sec/chip",
+                    "vs_baseline": None,
+                    "detail": r,
+                }
+            )
+        )
+        return
+
+    if args.lm:
+        r = bench_lm()
+        r.update(backend)
+        print(
+            json.dumps(
+                {
+                    "metric": "lm_train_tokens_per_sec_per_chip",
+                    "value": r["tokens_per_sec_per_chip"],
+                    "unit": "tokens/sec/chip",
+                    "vs_baseline": None,
+                    "mfu": r["mfu"],
+                    "detail": r,
+                }
+            )
+        )
+        return
 
     if args.loader:
         r = bench_loader()

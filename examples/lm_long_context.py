@@ -49,33 +49,55 @@ def main():
         help="per-block activation rematerialization (the long-context "
         "HBM lever: only block-boundary residuals are stored)",
     )
+    parser.add_argument(
+        "--flash", action="store_true",
+        help="single-device blockwise Pallas attention "
+        "(ops/pallas_attention.py) instead of the device-ring: the "
+        "whole sequence on one chip, scores never in HBM — the "
+        "single-chip half of the long-context design",
+    )
     args = parser.parse_args()
 
     mdt.initialize_runtime()
     (g,) = mdt.setup_groups(1)
-    if args.seq_len % g.size:
+    if not args.flash and args.seq_len % g.size:
+        # only the device-ring shards the sequence; flash keeps it whole
         parser.error(f"--seq-len must divide by {g.size} devices")
-    print(
-        f"ring of {g.size} devices; {args.seq_len} tokens "
-        f"({args.seq_len // g.size} per device)"
-    )
+    if args.flash:
+        from multidisttorch_tpu.ops.pallas_attention import make_flash_attention
+
+        attention = make_flash_attention(causal=True)
+        print(f"flash attention on 1 device; {args.seq_len} tokens resident")
+    else:
+        attention = make_ring_attention(g, causal=True)
+        print(
+            f"ring of {g.size} devices; {args.seq_len} tokens "
+            f"({args.seq_len // g.size} per device)"
+        )
 
     model = TransformerLM(
         vocab_size=args.vocab,
         d_model=args.d_model,
         num_layers=args.layers,
         max_len=args.seq_len,
-        attention=make_ring_attention(g, causal=True),
+        attention=attention,
         remat=args.remat,
     )
     tx = optax.adam(args.lr)
     state = create_lm_state(g, model, tx, jax.random.key(0),
                             example_len=args.seq_len)
-    step = make_lm_train_step(g, model, tx, sequence_parallel=True)
+    step = make_lm_train_step(g, model, tx,
+                              sequence_parallel=not args.flash)
 
     # Periodic corpus: perfectly learnable, so the loss trend is the
     # whole story.
     period = 16
+    if args.flash and args.batch_size % g.size:
+        # flash mode shards the BATCH over the group (plain DP; the
+        # sequence stays whole per device) — round the batch up.
+        args.batch_size = ((args.batch_size // g.size) + 1) * g.size
+        print(f"flash mode: batch rounded up to {args.batch_size} "
+              f"(divisible by {g.size} devices)")
     base = np.tile(np.arange(period), args.seq_len // period + 1)
     rows = [
         (base[: args.seq_len] + 2 * r) % args.vocab
@@ -83,7 +105,7 @@ def main():
     ]
     tokens = jax.device_put(
         jnp.asarray(np.stack(rows).astype(np.int32)),
-        g.sharding(None, DATA_AXIS),
+        g.batch_sharding if args.flash else g.sharding(None, DATA_AXIS),
     )
 
     t0 = time.time()

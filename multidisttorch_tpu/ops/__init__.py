@@ -5,6 +5,10 @@ from multidisttorch_tpu.ops.losses import (
     softmax_cross_entropy_mean,
 )
 from multidisttorch_tpu.ops.moe import MoEMLP, moe_ep_shardings
+from multidisttorch_tpu.ops.pallas_attention import (
+    flash_attention,
+    make_flash_attention,
+)
 from multidisttorch_tpu.ops.pallas_elbo import fused_elbo_loss_sum
 from multidisttorch_tpu.ops.ring_attention import (
     dense_attention_reference,

@@ -1,0 +1,384 @@
+"""Blockwise (flash) causal attention as Pallas TPU kernels.
+
+The reference framework has no attention at all (SURVEY.md §5); this
+repo's long-context story is ring attention across chips
+(``ops/ring_attention.py``) — but *within* one chip the attention block
+still materializes the full ``(B, H, Tq, Tk)`` score matrix in HBM,
+which caps single-chip context length and wastes bandwidth on the
+framework's own TransformerLM. This module is the single-chip half of
+the long-context design: an exact, online-softmax attention that tiles
+Q/K/V into VMEM blocks, keeps the running max/sum in VMEM scratch, and
+never writes scores to HBM. Forward and backward are both Pallas
+kernels wired through ``jax.custom_vjp`` (the backward recomputes
+probabilities from the saved per-row logsumexp — the standard
+flash-attention memory trade).
+
+Layout contract matches ``make_ring_attention``: ``(batch, seq, heads,
+head_dim)``; bf16 or f32 in, accumulation always f32. Off-TPU the
+kernels run in interpreter mode (bit-exact semantics, used by the CPU
+test suite). Sequence lengths divisible by 128 tile at the MXU edge;
+other lengths run as one whole-sequence block (see
+:func:`flash_attention`). The dense fallback applies only when Pallas
+itself is unavailable.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from multidisttorch_tpu.ops.ring_attention import dense_attention_reference
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAVE_PALLAS = True
+except ImportError:  # pragma: no cover
+    _HAVE_PALLAS = False
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# Q/K tile edge: 128 matches the MXU systolic array; shorter sequences
+# use the whole sequence as one block.
+_BLOCK = 128
+_NEG_INF = -1e30  # finite sentinel: -inf rows poison exp() on the VPU
+
+
+def _blocks(t: int) -> int:
+    return _BLOCK if t % _BLOCK == 0 else t
+
+
+# ---------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_sc, l_sc,
+                *, scale, causal, block_q, block_k):
+    """Grid (BH, nq, nk), nk innermost ("arbitrary"): one Q block's
+    online-softmax accumulation across K blocks, carried in VMEM
+    scratch; outputs written on the last K step."""
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_sc[:] = jnp.full_like(m_sc, _NEG_INF)
+        l_sc[:] = jnp.zeros_like(l_sc)
+        acc[:] = jnp.zeros_like(acc)
+
+    # Causal: K blocks strictly above the diagonal contribute nothing.
+    # (`causal` is static; the block comparison is traced — they can't
+    # share one boolean expression.)
+    q_start = iq * block_q
+    k_start = ik * block_k
+
+    def _block():
+        q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # (block_q, block_k)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        m_prev = m_sc[:]  # (block_q, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)  # rows at _NEG_INF underflow to 0 exactly
+        corr = jnp.exp(m_prev - m_new)
+        l_sc[:] = l_sc[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        m_sc[:] = m_new
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(k_start <= q_start + block_q - 1)(_block)
+    else:
+        _block()
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        l = l_sc[:]
+        denom = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc[:] / denom).astype(o_ref.dtype)
+        # logsumexp per row — the one residual the backward needs to
+        # rebuild p without the (Tq, Tk) matrix.
+        lse_ref[0] = (m_sc[:] + jnp.log(denom))[:, 0]
+
+
+def _fwd_call(q, k, v, scale, causal):
+    bh, t, d = q.shape
+    bq, bk = _blocks(t), _blocks(t)
+    kernel = partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+    )
+    grid = (bh, t // bq, t // bk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),   # acc
+            pltpu.VMEM((bq, 1), jnp.float32),   # running max
+            pltpu.VMEM((bq, 1), jnp.float32),   # running sum
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    """Grid (BH, nq, nk): dQ for one Q block, accumulated across K."""
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+
+    def _block():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])  # exact probs via saved lse
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(k_start <= q_start + block_q - 1)(_block)
+    else:
+        _block()
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale, causal, block_q, block_k):
+    """Grid (BH, nk, nq): dK/dV for one K block, accumulated across Q."""
+    ik, iq = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = iq * block_q
+    k_start = ik * block_k
+
+    def _block():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            cols = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])  # (block_q, block_k)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    if causal:
+        pl.when(k_start <= q_start + block_q - 1)(_block)
+    else:
+        _block()
+
+    @pl.when(iq == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_call(q, k, v, o, lse, do, scale, causal):
+    bh, t, d = q.shape
+    bq, bk = _blocks(t), _blocks(t)
+    # delta_i = rowsum(dO ⊙ O): tiny elementwise reduce; XLA fuses it.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )  # (bh, t)
+
+    wide = lambda blk: pl.BlockSpec(
+        (1, blk, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
+    )
+    row = pl.BlockSpec((1, bq), lambda b, i, j: (b, i),
+                       memory_space=pltpu.VMEM)
+    other = lambda blk: pl.BlockSpec(
+        (1, blk, d), lambda b, i, j: (b, j, 0), memory_space=pltpu.VMEM
+    )
+    other_row = pl.BlockSpec((1, bq), lambda b, i, j: (b, j),
+                             memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                block_q=bq, block_k=bk),
+        grid=(bh, t // bq, t // bk),
+        in_specs=[wide(bq), other(bk), other(bk), wide(bq), row, row],
+        out_specs=wide(bq),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                block_q=bq, block_k=bk),
+        grid=(bh, t // bk, t // bq),
+        in_specs=[other(bq), wide(bk), wide(bk), other(bq),
+                  other_row, other_row],
+        out_specs=(wide(bk), wide(bk)),
+        out_shape=(
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------
+# public entry (custom_vjp over the (BH, T, D)-flattened layout)
+# ---------------------------------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_flat(q, k, v, scale, causal):
+    return _fwd_call(q, k, v, scale, causal)[0]
+
+
+def _flash_flat_fwd(q, k, v, scale, causal):
+    o, lse = _fwd_call(q, k, v, scale, causal)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_flat_bwd(scale, causal, res, g):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd_call(q, k, v, o, lse, g, scale, causal)
+    return dq, dk, dv
+
+
+_flash_flat.defvjp(_flash_flat_fwd, _flash_flat_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False):
+    """Exact blockwise attention; drop-in for
+    :func:`ops.ring_attention.dense_attention_reference`.
+
+    ``q, k, v``: ``(batch, seq, heads, head_dim)``, bf16 or f32. Scores
+    and the softmax never touch HBM; memory is O(T·D) instead of O(T²).
+    Sequences that are a multiple of 128 tile at the MXU edge; shorter
+    or non-divisible sequences run as one whole-sequence block (fine
+    for small T — a huge non-divisible T should be padded by the
+    caller instead).
+    """
+    if not _HAVE_PALLAS:
+        return dense_attention_reference(q, k, v, causal=causal)
+    b, t, h, d = q.shape
+    scale = 1.0 / (d**0.5)
+    # (B, T, H, D) -> (B*H, T, D): each (batch, head) pair is an
+    # independent attention problem and a grid row.
+    to_flat = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    o = _flash_flat(to_flat(q), to_flat(k), to_flat(v), scale, causal)
+    return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def make_flash_attention(*, causal: bool = True):
+    """An ``attention=`` callable for :class:`models.transformer
+    .TransformerLM` using the Pallas kernel on the chip-local sequence."""
+    return partial(flash_attention, causal=causal)

@@ -1,0 +1,117 @@
+"""Flash (blockwise Pallas) attention: value + gradient parity with the
+dense reference (interpreter mode on CPU; same kernels compile on TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from multidisttorch_tpu.ops.pallas_attention import (
+    _BLOCK,
+    flash_attention,
+    make_flash_attention,
+)
+from multidisttorch_tpu.ops.ring_attention import dense_attention_reference
+
+
+def _qkv(b=2, t=64, h=2, d=16, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(0, 1, (b, t, h, d)).astype(dtype))
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_value_parity_single_block(causal):
+    q, k, v = _qkv(t=64)  # t < _BLOCK: one whole-sequence block
+    out = flash_attention(q, k, v, causal=causal)
+    ref = dense_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_value_parity_multi_block(causal):
+    # t = 2 * _BLOCK exercises the online-softmax carry across K blocks
+    # and (causal) the skipped above-diagonal block.
+    q, k, v = _qkv(t=2 * _BLOCK, h=1, d=8)
+    out = flash_attention(q, k, v, causal=causal)
+    ref = dense_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradient_parity(causal):
+    q, k, v = _qkv(t=2 * _BLOCK, h=1, d=8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(
+            dense_attention_reference(q, k, v, causal=causal) ** 2
+        )
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_dense):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-6
+        )
+
+
+def test_bf16_roundtrip():
+    q, k, v = _qkv(t=64, dtype=np.float32)
+    qb, kb, vb = (a.astype(jnp.bfloat16) for a in (q, k, v))
+    out = flash_attention(qb, kb, vb, causal=True)
+    assert out.dtype == jnp.bfloat16
+    ref = dense_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), np.asarray(ref),
+        rtol=3e-2, atol=3e-2,  # bf16 storage precision
+    )
+    # gradients flow and come back in the primal dtype
+    g = jax.grad(
+        lambda q: jnp.sum(
+            flash_attention(q, kb, vb, causal=True).astype(jnp.float32) ** 2
+        )
+    )(qb)
+    assert g.dtype == jnp.bfloat16 and bool(jnp.isfinite(g.astype(jnp.float32)).all())
+
+
+def test_drives_transformer_lm():
+    # The kernel is the TransformerLM's single-chip attention: one real
+    # optimizer step decreases the loss and matches the dense-attention
+    # model's loss on identical params.
+    import optax
+
+    from multidisttorch_tpu.models.transformer import TransformerLM
+    from multidisttorch_tpu.parallel.mesh import setup_groups
+    from multidisttorch_tpu.train.lm import create_lm_state, make_lm_train_step
+
+    (trial,) = setup_groups(1)
+    mk = lambda attn: TransformerLM(
+        vocab_size=64, d_model=32, num_heads=2, num_layers=2,
+        max_len=64, attention=attn,
+    )
+    flash_model = mk(make_flash_attention(causal=True))
+    dense_model = mk(None)
+    tx = optax.adam(1e-3)
+    state = create_lm_state(trial, flash_model, tx, jax.random.key(0),
+                            example_len=64)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, (8, 64), dtype=np.int32)
+    )  # batch divisible by the trial's 8-device data axis
+
+    step_flash = make_lm_train_step(trial, flash_model, tx)
+    s1, m1 = step_flash(state, tokens)
+    # identical params through the dense model -> same loss
+    state_d = create_lm_state(trial, dense_model, tx, jax.random.key(0),
+                              example_len=64)
+    _, m2 = make_lm_train_step(trial, dense_model, tx)(state_d, tokens)
+    assert float(m1["loss"]) == pytest.approx(float(m2["loss"]), rel=1e-4)
+    # training continues and improves
+    s2, m3 = step_flash(s1, tokens)
+    assert float(m3["loss"]) < float(m1["loss"])
