@@ -56,7 +56,15 @@ def main():
         "whole sequence on one chip, scores never in HBM — the "
         "single-chip half of the long-context design",
     )
+    parser.add_argument(
+        "--ring-flash", action="store_true",
+        help="both halves composed: K/V ring over the device group AND "
+        "the Pallas flash kernel inside every hop (scores only ever in "
+        "VMEM) — the framework's full long-context configuration",
+    )
     args = parser.parse_args()
+    if args.flash and args.ring_flash:
+        parser.error("--flash and --ring-flash are mutually exclusive")
 
     mdt.initialize_runtime()
     (g,) = mdt.setup_groups(1)
@@ -68,6 +76,16 @@ def main():
 
         attention = make_flash_attention(causal=True)
         print(f"flash attention on 1 device; {args.seq_len} tokens resident")
+    elif args.ring_flash:
+        from multidisttorch_tpu.ops.pallas_attention import (
+            make_ring_flash_attention,
+        )
+
+        attention = make_ring_flash_attention(g, causal=True)
+        print(
+            f"ring-flash over {g.size} devices; {args.seq_len} tokens "
+            f"({args.seq_len // g.size} per device, flash-kernel hops)"
+        )
     else:
         attention = make_ring_attention(g, causal=True)
         print(

@@ -8,6 +8,7 @@ from multidisttorch_tpu.ops.moe import MoEMLP, moe_ep_shardings
 from multidisttorch_tpu.ops.pallas_attention import (
     flash_attention,
     make_flash_attention,
+    make_ring_flash_attention,
 )
 from multidisttorch_tpu.ops.pallas_elbo import fused_elbo_loss_sum
 from multidisttorch_tpu.ops.ring_attention import (

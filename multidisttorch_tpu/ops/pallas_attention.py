@@ -24,7 +24,7 @@ itself is unavailable.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -276,13 +276,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_call(q, k, v, o, lse, do, scale, causal):
+def _bwd_call(q, k, v, o, lse, do, scale, causal, g_lse=None):
     bh, t, d = q.shape
     bq, bk = _blocks(t), _blocks(t)
     # delta_i = rowsum(dO ⊙ O): tiny elementwise reduce; XLA fuses it.
+    # An lse cotangent folds in here with no kernel change: the shared
+    # score gradient is ds = p·(dp − delta + g_lse), and the kernels
+    # compute ds = p·(dp − delta'), so delta' = delta − g_lse.
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )  # (bh, t)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
 
     wide = lambda blk: pl.BlockSpec(
         (1, blk, d), lambda b, i, j: (b, i, 0), memory_space=pltpu.VMEM
@@ -338,22 +343,32 @@ def _bwd_call(q, k, v, o, lse, do, scale, causal):
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_flat(q, k, v, scale, causal):
-    return _fwd_call(q, k, v, scale, causal)[0]
+def _flash_flat_lse(q, k, v, scale, causal):
+    """``(o, lse)`` over the flattened ``(BH, T, D)`` layout.
+
+    Exposing lse (per-row logsumexp of the scores) with a real VJP is
+    what lets :func:`make_ring_flash_attention` combine per-hop partial
+    attentions differentiably — the hop weights are ``exp(lse_h − m)``,
+    so gradients flow into lse, not just into ``o``.
+    """
+    return _fwd_call(q, k, v, scale, causal)
 
 
 def _flash_flat_fwd(q, k, v, scale, causal):
     o, lse = _fwd_call(q, k, v, scale, causal)
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
 def _flash_flat_bwd(scale, causal, res, g):
     q, k, v, o, lse = res
-    dq, dk, dv = _bwd_call(q, k, v, o, lse, g, scale, causal)
+    g_o, g_lse = g
+    dq, dk, dv = _bwd_call(
+        q, k, v, o, lse, g_o, scale, causal, g_lse=g_lse
+    )
     return dq, dk, dv
 
 
-_flash_flat.defvjp(_flash_flat_fwd, _flash_flat_bwd)
+_flash_flat_lse.defvjp(_flash_flat_fwd, _flash_flat_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False):
@@ -374,7 +389,7 @@ def flash_attention(q, k, v, *, causal: bool = False):
     # (B, T, H, D) -> (B*H, T, D): each (batch, head) pair is an
     # independent attention problem and a grid row.
     to_flat = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    o = _flash_flat(to_flat(q), to_flat(k), to_flat(v), scale, causal)
+    o, _ = _flash_flat_lse(to_flat(q), to_flat(k), to_flat(v), scale, causal)
     return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
@@ -382,3 +397,135 @@ def make_flash_attention(*, causal: bool = True):
     """An ``attention=`` callable for :class:`models.transformer
     .TransformerLM` using the Pallas kernel on the chip-local sequence."""
     return partial(flash_attention, causal=causal)
+
+
+# ---------------------------------------------------------------------
+# ring-flash: sequence parallelism across chips, flash within each hop
+# ---------------------------------------------------------------------
+
+
+def _ring_flash_local(q, k, v, *, axis_name, num_devices, causal, scale):
+    """Per-device body under shard_map: the full ring-flash composition.
+
+    Local Q stays put; K/V blocks rotate around the ring
+    (``ops/ring_attention.py``'s topology), but each hop's block pair
+    is computed by the Pallas flash kernel instead of a materialized
+    einsum — so the per-hop ``(T/N, T/N)`` scores live only in VMEM.
+    Hops combine through their logsumexps in an online-softmax carry
+    (plain jnp, so the whole thing reverse-differentiates: each hop's
+    cotangents re-enter the kernel's custom VJP, including the lse
+    term).
+
+    Causal structure per hop: a block strictly left of the diagonal is
+    plain full attention, the diagonal block is locally-causal (equal
+    global offsets make local masking exact), and blocks right of the
+    diagonal contribute nothing (lse = -inf sentinel → zero weight).
+    """
+    b, t_loc, h, d = q.shape
+    my = jax.lax.axis_index(axis_name)
+    flat = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t_loc, d)
+    qf = flat(q)
+
+    from multidisttorch_tpu.parallel.collectives import pvary
+
+    m0 = pvary(jnp.full((b * h, t_loc), _NEG_INF, jnp.float32), axis_name)
+    l0 = pvary(jnp.zeros((b * h, t_loc), jnp.float32), axis_name)
+    acc0 = pvary(
+        jnp.zeros((b * h, t_loc, d), jnp.float32), axis_name
+    )
+    perm = [(i, (i + 1) % num_devices) for i in range(num_devices)]
+
+    def body(carry, step):
+        kf, vf, m, l, acc = carry
+
+        def full():
+            return _flash_flat_lse(qf, kf, vf, scale, False)
+
+        def diag():
+            return _flash_flat_lse(qf, kf, vf, scale, True)
+
+        def skip():
+            return (
+                jnp.zeros_like(qf),
+                jnp.full((b * h, t_loc), _NEG_INF, jnp.float32),
+            )
+
+        if causal:
+            src = (my - step) % num_devices
+            mode = jnp.where(src == my, 1, jnp.where(src < my, 0, 2))
+            o_h, lse_h = jax.lax.switch(mode, [full, diag, skip])
+        else:
+            o_h, lse_h = full()
+
+        m_new = jnp.maximum(m, lse_h)
+        c = jnp.exp(m - m_new)
+        w = jnp.exp(lse_h - m_new)
+        l_new = l * c + w
+        acc_new = acc * c[..., None] + w[..., None] * o_h.astype(jnp.float32)
+        kf_next = jax.lax.ppermute(kf, axis_name, perm)
+        vf_next = jax.lax.ppermute(vf, axis_name, perm)
+        return (kf_next, vf_next, m_new, l_new, acc_new), None
+
+    (_, _, _, l, acc), _ = jax.lax.scan(
+        body, (flat(k), flat(v), m0, l0, acc0), jnp.arange(num_devices)
+    )
+    out = acc / jnp.where(l > 0, l, 1.0)[..., None]
+    return (
+        out.reshape(b, h, t_loc, d).transpose(0, 2, 1, 3).astype(q.dtype)
+    )
+
+
+@lru_cache(maxsize=None)
+def _make_ring_flash_cached(mesh, causal: bool):
+    from jax.sharding import PartitionSpec as P
+
+    from multidisttorch_tpu.parallel.mesh import DATA_AXIS
+
+    num_devices = int(mesh.shape[DATA_AXIS])
+    spec = P(None, DATA_AXIS, None, None)
+
+    def fn(q, k, v):
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        return jax.shard_map(
+            partial(
+                _ring_flash_local,
+                axis_name=DATA_AXIS,
+                num_devices=num_devices,
+                causal=causal,
+                scale=scale,
+            ),
+            mesh=mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            # pallas_call's out_shape carries no VMA annotation, so the
+            # varying-axis checker can't type the per-hop kernel
+            # results (same constraint as the fused ELBO loss under
+            # shard_map — train/steps.py).
+            check_vma=False,
+        )(q, k, v)
+
+    return jax.jit(fn)
+
+
+def make_ring_flash_attention(trial, *, causal: bool = False):
+    """Sequence-parallel exact attention with flash-kernel hops.
+
+    Same contract and sharding as
+    :func:`ops.ring_attention.make_ring_attention` — ``(batch, seq,
+    heads, head_dim)`` with ``seq`` sharded over the trial's data axis —
+    but the per-hop block computation is the Pallas kernel, so no
+    device ever materializes even a ``(T/N, T/N)`` score block in HBM.
+    This is the composition the long-context design is built around:
+    ICI ring for the cross-chip half, VMEM blocking for the
+    within-chip half. Compiled functions are memoized per
+    ``(mesh, causal)`` like :func:`make_ring_attention`; without
+    Pallas the plain ring (HBM-block hops) is returned instead.
+    """
+    from multidisttorch_tpu.parallel.mesh import TrialMesh
+
+    if not _HAVE_PALLAS:
+        from multidisttorch_tpu.ops.ring_attention import make_ring_attention
+
+        return make_ring_attention(trial, causal=causal)
+    mesh = trial.mesh if isinstance(trial, TrialMesh) else trial
+    return _make_ring_flash_cached(mesh, causal)

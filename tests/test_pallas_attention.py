@@ -81,6 +81,94 @@ def test_bf16_roundtrip():
     assert g.dtype == jnp.bfloat16 and bool(jnp.isfinite(g.astype(jnp.float32)).all())
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_dense(causal):
+    # The full composition: K/V ring over 8 devices, Pallas flash
+    # kernel inside each hop, logsumexp combination across hops.
+    from multidisttorch_tpu.ops.pallas_attention import (
+        make_ring_flash_attention,
+    )
+    from multidisttorch_tpu.parallel.mesh import DATA_AXIS, setup_groups
+
+    (trial,) = setup_groups(1)
+    t = 16 * trial.size
+    q, k, v = _qkv(b=2, t=t, h=2, d=8, seed=3)
+    q, k, v = (
+        jax.device_put(a, trial.sharding(None, DATA_AXIS))
+        for a in (q, k, v)
+    )
+    out = make_ring_flash_attention(trial, causal=causal)(q, k, v)
+    ref = dense_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_ring_flash_gradient_matches_dense():
+    # Gradients flow through the hop combination into the kernel's VJP
+    # — including the lse cotangent (the hop-weight term), which only
+    # this path exercises.
+    from multidisttorch_tpu.ops.pallas_attention import (
+        make_ring_flash_attention,
+    )
+    from multidisttorch_tpu.parallel.mesh import DATA_AXIS, setup_groups
+
+    (trial,) = setup_groups(1)
+    t = 16 * trial.size
+    q, k, v = _qkv(b=1, t=t, h=1, d=8, seed=4)
+    sh = trial.sharding(None, DATA_AXIS)
+    qs, ks, vs = (jax.device_put(a, sh) for a in (q, k, v))
+    ring = make_ring_flash_attention(trial, causal=True)
+
+    g_ring = jax.grad(
+        lambda q, k, v: jnp.sum(ring(q, k, v) ** 2), argnums=(0, 1, 2)
+    )(qs, ks, vs)
+    g_dense = jax.grad(
+        lambda q, k, v: jnp.sum(
+            dense_attention_reference(q, k, v, causal=True) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_ring, g_dense):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5
+        )
+
+
+def test_ring_flash_drives_sequence_parallel_lm():
+    # End to end: the TransformerLM trains sequence-parallel with
+    # ring-flash as its attention — loss decreases over steps.
+    import optax
+
+    from multidisttorch_tpu.models.transformer import TransformerLM
+    from multidisttorch_tpu.ops.pallas_attention import (
+        make_ring_flash_attention,
+    )
+    from multidisttorch_tpu.parallel.mesh import DATA_AXIS, setup_groups
+    from multidisttorch_tpu.train.lm import create_lm_state, make_lm_train_step
+
+    (trial,) = setup_groups(1)
+    t = 8 * trial.size
+    model = TransformerLM(
+        vocab_size=32, d_model=32, num_heads=2, num_layers=1, max_len=t,
+        attention=make_ring_flash_attention(trial, causal=True),
+    )
+    tx = optax.adam(3e-3)
+    state = create_lm_state(trial, model, tx, jax.random.key(0),
+                            example_len=t)
+    step = make_lm_train_step(trial, model, tx, sequence_parallel=True)
+    tokens = jax.device_put(
+        jnp.asarray(
+            np.tile(np.arange(t) % 32, (2, 1)).astype(np.int32)
+        ),
+        trial.sharding(None, DATA_AXIS),
+    )
+    state, m0 = step(state, tokens)
+    for _ in range(10):
+        state, m = step(state, tokens)
+    assert float(m["loss"]) < float(m0["loss"])
+
+
 def test_drives_transformer_lm():
     # The kernel is the TransformerLM's single-chip attention: one real
     # optimizer step decreases the loss and matches the dense-attention
