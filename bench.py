@@ -523,7 +523,12 @@ def bench_lm() -> dict:
     tok_s, rates, final_loss = variants[winner]
 
     ndev = len(jax.devices())
-    flops = _lm_train_flops_per_token()
+    # pass the module globals explicitly: the function's defaults were
+    # bound at import, so a caller shrinking LM_* (tests) must still
+    # get a FLOPs figure consistent with the reported config
+    flops = _lm_train_flops_per_token(
+        d=LM_DMODEL, layers=LM_LAYERS, t=LM_SEQ, vocab=LM_VOCAB
+    )
     d0 = jax.devices()[0]
     peak = _peak_flops_per_chip(d0.device_kind) if on_tpu else None
     return {

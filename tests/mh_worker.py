@@ -246,15 +246,20 @@ def main():
             },
         }
 
-    elif mode == "lm_sp":
+    elif mode in ("lm_sp", "lm_sp_flash"):
         # Sequence parallelism ACROSS PROCESSES: one 64-token context
         # sharded over all 8 devices of the 2-process world; ring
         # attention's K/V blocks cross the process boundary on the
         # ppermute ring. Both processes must train identically.
+        # lm_sp_flash runs the same world through the ring-flash path
+        # (Pallas-kernel hops, ops/pallas_attention.py) instead.
         import numpy as np
         import optax
 
         from multidisttorch_tpu.models.transformer import TransformerLM
+        from multidisttorch_tpu.ops.pallas_attention import (
+            make_ring_flash_attention,
+        )
         from multidisttorch_tpu.ops.ring_attention import make_ring_attention
         from multidisttorch_tpu.parallel.mesh import DATA_AXIS, setup_groups
         from multidisttorch_tpu.train.lm import (
@@ -263,9 +268,13 @@ def main():
         )
 
         (g,) = setup_groups(1)
+        make_attn = (
+            make_ring_flash_attention if mode == "lm_sp_flash"
+            else make_ring_attention
+        )
         model = TransformerLM(
             vocab_size=16, d_model=32, num_heads=2, num_layers=2,
-            max_len=64, attention=make_ring_attention(g, causal=True),
+            max_len=64, attention=make_attn(g, causal=True),
         )
         tx = optax.adam(3e-3)
         state = create_lm_state(g, model, tx, jax.random.key(0),

@@ -70,6 +70,10 @@ def test_bench_lm_smoke(monkeypatch):
     assert r["tokens_per_sec_per_chip"] > 0
     assert r["attention_winner"] == "dense_xla"  # flash is TPU-gated
     assert r["mfu"] is None  # no peak off-TPU
+    # FLOPs figure must describe the (shrunk) config it reports
+    assert r["train_flops_per_token"] == bench._lm_train_flops_per_token(
+        d=32, layers=1, t=32, vocab=64
+    )
     import numpy as np
 
     assert np.isfinite(r["final_loss"])

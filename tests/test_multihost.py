@@ -233,6 +233,19 @@ def test_sequence_parallel_lm_spans_processes(tmp_path):
 
 
 @pytest.mark.multihost
+def test_ring_flash_lm_spans_processes(tmp_path):
+    # Same cross-process long-context world through the ring-flash path:
+    # each hop's block pair runs the Pallas flash kernel while K/V
+    # cross the process boundary on the ppermute ring.
+    r0, r1 = _launch("lm_sp_flash", tmp_path)
+    assert r0["seq_shard_len"] == 8
+    assert r0["first_loss"] == r1["first_loss"]
+    assert r0["final_loss"] == r1["final_loss"]
+    assert r0["first_loss"] > 1.5
+    assert r0["final_loss"] < 0.8
+
+
+@pytest.mark.multihost
 def test_spanning_tp_trial_checkpoints(tmp_path):
     # Weight-sharded (TP) trial spanning 2 processes with checkpointing
     # on: the epoch checkpoint must gather-to-replicated on all owners
