@@ -439,8 +439,8 @@ LM_SEQ, LM_BATCH, LM_STEPS = 512, 16, 20
 
 
 def _lm_train_flops_per_token(
-    d: int = LM_DMODEL, layers: int = LM_LAYERS, t: int = LM_SEQ,
-    vocab: int = LM_VOCAB,
+    d: int | None = None, layers: int | None = None, t: int | None = None,
+    vocab: int | None = None,
 ) -> float:
     """Analytic matmul FLOPs for one LM optimizer step, per token.
 
@@ -449,8 +449,14 @@ def _lm_train_flops_per_token(
     2·T·d (QKᵀ + AV at 4·T·d, halved by the causal mask) + the
     d·vocab head (2·d·V). Train ≈ 3x forward (same dense-stack
     argument as :func:`_train_flops_per_sample`); embedding lookups
-    are gathers, not FLOPs.
+    are gathers, not FLOPs. Defaults resolve to the LM_* module
+    globals at CALL time (None sentinels, not def-time binding), so a
+    shrunk configuration always gets a consistent figure.
     """
+    d = LM_DMODEL if d is None else d
+    layers = LM_LAYERS if layers is None else layers
+    t = LM_SEQ if t is None else t
+    vocab = LM_VOCAB if vocab is None else vocab
     fwd = layers * (24.0 * d * d + 2.0 * t * d) + 2.0 * d * vocab
     return 3.0 * fwd
 
@@ -523,12 +529,7 @@ def bench_lm() -> dict:
     tok_s, rates, final_loss = variants[winner]
 
     ndev = len(jax.devices())
-    # pass the module globals explicitly: the function's defaults were
-    # bound at import, so a caller shrinking LM_* (tests) must still
-    # get a FLOPs figure consistent with the reported config
-    flops = _lm_train_flops_per_token(
-        d=LM_DMODEL, layers=LM_LAYERS, t=LM_SEQ, vocab=LM_VOCAB
-    )
+    flops = _lm_train_flops_per_token()
     d0 = jax.devices()[0]
     peak = _peak_flops_per_chip(d0.device_kind) if on_tpu else None
     return {

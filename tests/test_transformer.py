@@ -103,6 +103,43 @@ def test_sequence_parallel_training_learns_pattern():
     assert losses[-1] < 0.7, losses[-1]
 
 
+def test_trial_parallel_sequence_parallel_lms():
+    # The composition examples/lm_hpo.py demonstrates: TWO concurrent
+    # LM trials, each sequence-parallel on its own 4-device submesh
+    # ring. Both must train independently (different lrs -> different
+    # losses) and both must learn.
+    groups = setup_groups(2)
+    trials = []
+    for g, lr in zip(groups, (1e-3, 3e-3)):
+        model = TransformerLM(
+            vocab_size=16, d_model=32, num_heads=2, num_layers=1,
+            max_len=32, attention=make_ring_attention(g, causal=True),
+        )
+        tx = optax.adam(lr)
+        base = np.tile(np.arange(8), 4)[:32]
+        trials.append({
+            "state": create_lm_state(g, model, tx, jax.random.key(0),
+                                     example_len=32),
+            "step": make_lm_train_step(g, model, tx,
+                                       sequence_parallel=True),
+            "tokens": jax.device_put(
+                jnp.asarray(np.stack([base, (base + g.group_id) % 8])
+                            .astype(np.int32)),
+                g.sharding(None, DATA_AXIS),
+            ),
+        })
+    first = []
+    for i in range(40):
+        for t in trials:  # cooperative round-robin, no barriers
+            t["state"], t["m"] = t["step"](t["state"], t["tokens"])
+        if i == 0:
+            first = [float(t["m"]["loss"]) for t in trials]
+    last = [float(t["m"]["loss"]) for t in trials]
+    assert all(f > 1.5 for f in first)
+    assert all(l < 1.0 for l in last), last
+    assert last[0] != last[1]  # distinct hyperparameters, distinct runs
+
+
 def test_lm_loss_masks_final_position():
     # A wrong prediction ONLY at the rolled-around final target must not
     # change the loss.
