@@ -21,7 +21,7 @@ import json
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -89,7 +89,7 @@ class _Member:
         trial: TrialMesh,
         member_id: int,
         cfg: PBTConfig,
-        model: VAE,
+        model: Any,  # any VAE-family module: (recon_logits, mu, logvar)
         train_data: Dataset,
         eval_data: Dataset,
         lr: float,
@@ -147,8 +147,14 @@ def run_pbt(
     groups: Optional[Sequence[TrialMesh]] = None,
     out_dir: Optional[str] = None,
     verbose: bool = True,
+    model_builder=None,
 ) -> PBTResult:
     """Run synchronous-generation PBT, one member per submesh.
+
+    ``model_builder(cfg)`` swaps the model family, same contract as
+    ``run_hpo``: any module whose apply returns ``(recon_logits, mu,
+    logvar)`` (VAE, ConvVAE, MoEVAE) rides the shared train/eval steps;
+    the population trains the one architecture while PBT explores lr.
 
     A generation's explore phase is one scan-fused dispatch per member
     (``steps_per_generation`` optimizer updates in a single host
@@ -177,7 +183,11 @@ def run_pbt(
             f"population {cfg.population} but {len(groups)} device groups"
         )
 
-    model = VAE(hidden_dim=cfg.hidden_dim, latent_dim=cfg.latent_dim)
+    model = (
+        model_builder(cfg)
+        if model_builder is not None
+        else VAE(hidden_dim=cfg.hidden_dim, latent_dim=cfg.latent_dim)
+    )
     rng = np.random.default_rng(cfg.seed)
     init_lrs = np.exp(
         rng.uniform(np.log(cfg.lr_min), np.log(cfg.lr_max), cfg.population)

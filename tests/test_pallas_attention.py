@@ -62,6 +62,28 @@ def test_gradient_parity(causal):
         )
 
 
+def test_odd_head_dim_and_seq():
+    # Head dims off the VPU lane width (20) and non-128-divisible
+    # sequences (96 -> one whole-sequence block) must still be exact.
+    q, k, v = _qkv(b=1, t=96, h=2, d=20, seed=9)
+    out = flash_attention(q, k, v, causal=True)
+    ref = dense_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+    )
+    g = jax.grad(
+        lambda q: jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+    )(q)
+    g_ref = jax.grad(
+        lambda q: jnp.sum(
+            dense_attention_reference(q, k, v, causal=True) ** 2
+        )
+    )(q)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(g_ref), rtol=5e-5, atol=5e-6
+    )
+
+
 def test_bf16_roundtrip():
     q, k, v = _qkv(t=64, dtype=np.float32)
     qb, kb, vb = (a.astype(jnp.bfloat16) for a in (q, k, v))

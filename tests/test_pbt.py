@@ -59,6 +59,29 @@ def test_pbt_runs_and_improves(tmp_path):
     assert last <= first
 
 
+def test_pbt_swaps_model_family(tmp_path):
+    # model_builder generalizes the population's architecture, same
+    # contract as run_hpo: a ConvVAE population trains and scores
+    # through the shared VAE-family steps.
+    from multidisttorch_tpu.data.datasets import synthetic_cifar10
+    from multidisttorch_tpu.models.conv_vae import ConvVAE
+
+    train = synthetic_cifar10(64, seed=0)
+    evals = synthetic_cifar10(16, seed=1)
+    result = run_pbt(
+        _cfg(population=2, generations=2, batch_size=8),
+        train,
+        evals,
+        out_dir=str(tmp_path),
+        verbose=False,
+        model_builder=lambda cfg: ConvVAE(
+            base_channels=4, latent_dim=cfg.latent_dim
+        ),
+    )
+    assert np.isfinite(result.best_eval_loss)
+    assert len(result.history) == 2
+
+
 def test_pbt_exploit_transfers_weights():
     # Force an extreme population: one good lr, rest catastrophically
     # high; exploiters must copy the good member's weights + lr.

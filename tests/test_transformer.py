@@ -140,6 +140,36 @@ def test_trial_parallel_sequence_parallel_lms():
     assert last[0] != last[1]  # distinct hyperparameters, distinct runs
 
 
+def test_lm_state_checkpoint_roundtrip(tmp_path):
+    # The LM's TrainState rides the same msgpack checkpoint path as the
+    # VAE/classifier states: save mid-training, restore, and the next
+    # step must match the uninterrupted run bitwise.
+    from multidisttorch_tpu.train.checkpoint import restore_state, save_state
+
+    (g,) = setup_groups(1)
+    _, ring = _models(g)
+    tx = optax.adam(1e-3)
+    state = create_lm_state(g, ring, tx, jax.random.key(0), example_len=64)
+    step = make_lm_train_step(g, ring, tx, sequence_parallel=True)
+    base = np.tile(np.arange(8), 8)[:64]
+    tokens = jax.device_put(
+        jnp.asarray(np.stack([base, (base + 3) % 8]).astype(np.int32)),
+        g.sharding(None, DATA_AXIS),
+    )
+    for _ in range(3):
+        state, _ = step(state, tokens)
+    path = str(tmp_path / "lm.msgpack")
+    save_state(state, path)
+    cont, m_cont = step(state, tokens)
+
+    template = create_lm_state(g, ring, tx, jax.random.key(1),
+                               example_len=64)
+    restored = restore_state(template, path, g)
+    resumed, m_res = step(restored, tokens)
+    assert float(m_cont["loss"]) == float(m_res["loss"])
+    assert int(resumed.step) == int(cont.step) == 4
+
+
 def test_lm_loss_masks_final_position():
     # A wrong prediction ONLY at the rolled-around final target must not
     # change the loss.
