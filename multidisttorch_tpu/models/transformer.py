@@ -48,8 +48,14 @@ class Block(nn.Module):
         )
 
         y = ln("ln_attn")(x)
-        qkv = dense(3 * d, "qkv")(y).reshape(b, t, 3, h, d // h)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        # Separate q/k/v projections (not one fused 3d dense): each
+        # output's flat feature dim factors as [head, head_dim], so a
+        # tensor-parallel column sharding of the kernel IS a head
+        # sharding after the reshape — no resharding at the reshape,
+        # which the fused layout (proj-major [3, head, dh]) can't offer.
+        q = dense(d, "q")(y).reshape(b, t, h, d // h)
+        k = dense(d, "k")(y).reshape(b, t, h, d // h)
+        v = dense(d, "v")(y).reshape(b, t, h, d // h)
         attn = self.attention(q, k, v).reshape(b, t, d)
         x = x + dense(d, "proj")(attn)
 
@@ -125,18 +131,27 @@ class TransformerLM(nn.Module):
         )(x)
 
 
-def transformer_tp_shardings(trial, model: TransformerLM):
-    """Megatron-style tensor-parallel shardings for the LM's MLP blocks.
+def transformer_tp_shardings(
+    trial, model: TransformerLM, *, shard_attention: bool | str = "auto"
+):
+    """Megatron-style tensor-parallel shardings for the LM's blocks.
 
-    Each block's 4x MLP is the classic column/row pair — ``up``
-    column-parallel (output features sharded over the ``model`` axis),
-    ``down`` row-parallel (input features sharded; GSPMD closes the
-    pair with one psum) — which is where 2/3 of a transformer block's
-    parameters live. Attention projections, embeddings, norms, and the
-    head stay replicated (attention-head sharding composes with the
-    ring's sequence axis but is a different recipe; the MLP pair is the
-    exact, always-applicable one). Requires ``4*d_model`` divisible by
-    the model-axis extent.
+    Two column/row pairs per block, exactly Megatron's decomposition:
+
+    - MLP: ``up`` column-parallel (output features sharded over the
+      ``model`` axis), ``down`` row-parallel (input features sharded;
+      GSPMD closes the pair with one psum) — 2/3 of a block's params.
+    - Attention (``shard_attention``): ``q``/``k``/``v``
+      column-parallel — their flat feature dim factors as
+      ``[head, head_dim]``, so the column shard IS a head shard after
+      the reshape — and ``proj`` row-parallel closing with a psum.
+      Heads must divide the model axis; attention itself must be
+      per-head local under GSPMD (the default dense path — the ring
+      paths run inside their own shard_map with replicated-head specs,
+      so ``"auto"`` shards heads only when ``model.attention is None``).
+
+    Embeddings, norms, and the vocab head stay replicated. Requires
+    ``4*d_model`` divisible by the model-axis extent.
     """
     from multidisttorch_tpu.parallel.mesh import MODEL_AXIS
 
@@ -145,6 +160,13 @@ def transformer_tp_shardings(trial, model: TransformerLM):
         raise ValueError(
             f"4*d_model={4 * model.d_model} not divisible by the model "
             f"axis ({m})"
+        )
+    if shard_attention == "auto":
+        shard_attention = model.attention is None and model.num_heads % m == 0
+    if shard_attention and model.num_heads % m:
+        raise ValueError(
+            f"num_heads={model.num_heads} not divisible by the model "
+            f"axis ({m}); head sharding needs whole heads per device"
         )
     col = {
         "kernel": trial.sharding(None, MODEL_AXIS),
@@ -166,12 +188,15 @@ def transformer_tp_shardings(trial, model: TransformerLM):
         jnp.zeros((1, dummy_len), jnp.int32),
     )["params"]
 
+    col_names = {"up"} | ({"q", "k", "v"} if shard_attention else set())
+    row_names = {"down"} | ({"proj"} if shard_attention else set())
+
     def rule(path, _leaf):
         keys = [p.key for p in path if hasattr(p, "key")]
         if keys and keys[0].startswith("block_"):
-            if keys[1] == "up":
+            if keys[1] in col_names:
                 return col["kernel"] if keys[-1] == "kernel" else col["bias"]
-            if keys[1] == "down":
+            if keys[1] in row_names:
                 return row["kernel"] if keys[-1] == "kernel" else row["bias"]
         return repl
 

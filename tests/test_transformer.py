@@ -257,40 +257,93 @@ def test_lm_per_block_remat_gradients_and_losses_match():
     np.testing.assert_allclose(run(plain), run(remat), rtol=1e-5)
 
 
-def test_transformer_mlp_tp_matches_replicated():
-    # Megatron MLP pair sharded over a (data x model) submesh: identical
-    # training to the replicated LM (deterministic model — exact).
+def _tp_losses(cfg, tokens_np, model_parallel, shard_asserts=None):
+    """Train 3 deterministic steps of a dense-attention LM, replicated
+    (``model_parallel=1``) or TP-sharded; shared by both TP parity
+    tests so the harness can't drift between them."""
     from multidisttorch_tpu.models.transformer import transformer_tp_shardings
     from multidisttorch_tpu.train.steps import state_shardings
 
+    sh = None
+    if model_parallel == 1:
+        (g,) = setup_groups(1)
+    else:
+        (g,) = setup_groups(1, model_parallel=model_parallel)
+    model = TransformerLM(**cfg)
+    tx = optax.adam(1e-3)
+    if model_parallel == 1:
+        state = create_lm_state(g, model, tx, jax.random.key(0),
+                                example_len=16)
+    else:
+        state = create_lm_state(
+            g, model, tx, jax.random.key(0), example_len=16,
+            param_shardings=transformer_tp_shardings(g, model),
+        )
+        sh = state_shardings(state)
+        if shard_asserts is not None:
+            shard_asserts(state)
+    step = make_lm_train_step(g, model, tx, shardings=sh)
+    toks = jax.device_put(jnp.asarray(tokens_np), g.batch_sharding)
+    out = []
+    for _ in range(3):
+        state, m = step(state, toks)
+        out.append(float(m["loss"]))
+    return out
+
+
+def test_transformer_mlp_tp_matches_replicated():
+    # Megatron MLP pair sharded over a (data x model) submesh: identical
+    # training to the replicated LM (deterministic model — exact).
+    # num_heads=2 doesn't divide the model axis, so auto keeps the
+    # attention replicated and this covers the MLP-only configuration.
     tokens_np = np.asarray(_tokens(b=8, t=16, seed=5))
 
-    def losses(model_parallel):
-        if model_parallel == 1:
-            (g,) = setup_groups(1)
-            sh = None
-        else:
-            (g,) = setup_groups(1, model_parallel=model_parallel)
-        model = TransformerLM(**_COMMON)  # dense attention, DP over batch
-        tx = optax.adam(1e-3)
-        if model_parallel == 1:
-            state = create_lm_state(g, model, tx, jax.random.key(0),
-                                    example_len=16)
-        else:
-            state = create_lm_state(
-                g, model, tx, jax.random.key(0), example_len=16,
-                param_shardings=transformer_tp_shardings(g, model),
-            )
-            sh = state_shardings(state)
-            # MLP pair physically sharded: (32, 128) -> (32, 32) shards
-            k = state.params["block_0"]["up"]["kernel"]
-            assert k.addressable_shards[0].data.shape == (32, 128 // 4)
-        step = make_lm_train_step(g, model, tx, shardings=sh)
-        toks = jax.device_put(jnp.asarray(tokens_np), g.batch_sharding)
-        out = []
-        for _ in range(3):
-            state, m = step(state, toks)
-            out.append(float(m["loss"]))
-        return out
+    def check(state):
+        # MLP pair physically sharded: (32, 128) -> (32, 32) shards
+        k = state.params["block_0"]["up"]["kernel"]
+        assert k.addressable_shards[0].data.shape == (32, 128 // 4)
 
-    np.testing.assert_allclose(losses(1), losses(4), rtol=2e-4)
+    np.testing.assert_allclose(
+        _tp_losses(_COMMON, tokens_np, 1),
+        _tp_losses(_COMMON, tokens_np, 4, check),
+        rtol=2e-4,
+    )
+
+
+def test_transformer_attention_head_tp_matches_replicated():
+    # Full Megatron decomposition: q/k/v column-parallel (the column
+    # shard IS a head shard after the [head, head_dim] reshape), proj
+    # row-parallel, plus the MLP pair — vs the replicated LM.
+    tokens_np = np.asarray(_tokens(b=8, t=16, seed=6))
+    cfg = dict(_COMMON, num_heads=4)  # heads divide the model axis
+
+    def check(state):
+        # auto mode sharded the attention: q columns = heads split
+        k = state.params["block_0"]["q"]["kernel"]
+        assert k.addressable_shards[0].data.shape == (32, 32 // 4)
+        p = state.params["block_0"]["proj"]["kernel"]
+        assert p.addressable_shards[0].data.shape == (32 // 4, 32)
+
+    np.testing.assert_allclose(
+        _tp_losses(cfg, tokens_np, 1),
+        _tp_losses(cfg, tokens_np, 4, check),
+        rtol=2e-4,
+    )
+
+
+def test_tp_auto_skips_heads_for_ring_attention():
+    # The ring paths run inside shard_map with replicated-head specs, so
+    # "auto" must not shard heads when an explicit attention is set.
+    from multidisttorch_tpu.models.transformer import transformer_tp_shardings
+    from multidisttorch_tpu.parallel.mesh import MODEL_AXIS
+
+    (g,) = setup_groups(1, model_parallel=4)
+    ring_model = TransformerLM(
+        attention=make_ring_attention(g, causal=True),
+        **dict(_COMMON, num_heads=4),
+    )
+    sh = transformer_tp_shardings(g, ring_model)
+    q_spec = sh["block_0"]["q"]["kernel"].spec
+    up_spec = sh["block_0"]["up"]["kernel"].spec
+    assert MODEL_AXIS not in tuple(q_spec)  # heads replicated
+    assert MODEL_AXIS in tuple(up_spec)  # MLP still sharded
