@@ -146,9 +146,11 @@ def transformer_tp_shardings(
       ``[head, head_dim]``, so the column shard IS a head shard after
       the reshape — and ``proj`` row-parallel closing with a psum.
       Heads must divide the model axis; attention itself must be
-      per-head local under GSPMD (the default dense path — the ring
-      paths run inside their own shard_map with replicated-head specs,
-      so ``"auto"`` shards heads only when ``model.attention is None``).
+      per-head local. ``"auto"`` shards heads for the dense default
+      AND for ring/ring-flash callables built with head sharding
+      (``shard_heads="auto"`` on a 2-D mesh sets ``fn.head_sharded``);
+      a replicated-head ring keeps the attention projections
+      replicated.
 
     Embeddings, norms, and the vocab head stay replicated. Requires
     ``4*d_model`` divisible by the model-axis extent.
@@ -162,7 +164,13 @@ def transformer_tp_shardings(
             f"axis ({m})"
         )
     if shard_attention == "auto":
-        shard_attention = model.attention is None and model.num_heads % m == 0
+        # per-head-local attention paths: the dense default, or a ring
+        # built with head sharding (its shard_map splits heads over the
+        # model axis itself — fn.head_sharded marks it)
+        per_head_local = model.attention is None or getattr(
+            model.attention, "head_sharded", False
+        )
+        shard_attention = per_head_local and model.num_heads % m == 0
     if shard_attention and model.num_heads % m:
         raise ValueError(
             f"num_heads={model.num_heads} not divisible by the model "

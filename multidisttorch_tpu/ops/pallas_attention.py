@@ -476,13 +476,13 @@ def _ring_flash_local(q, k, v, *, axis_name, num_devices, causal, scale):
 
 
 @lru_cache(maxsize=None)
-def _make_ring_flash_cached(mesh, causal: bool):
+def _make_ring_flash_cached(mesh, causal: bool, head_axis=None):
     from jax.sharding import PartitionSpec as P
 
     from multidisttorch_tpu.parallel.mesh import DATA_AXIS
 
     num_devices = int(mesh.shape[DATA_AXIS])
-    spec = P(None, DATA_AXIS, None, None)
+    spec = P(None, DATA_AXIS, head_axis, None)
 
     def fn(q, k, v):
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -507,25 +507,37 @@ def _make_ring_flash_cached(mesh, causal: bool):
     return jax.jit(fn)
 
 
-def make_ring_flash_attention(trial, *, causal: bool = False):
+def make_ring_flash_attention(trial, *, causal: bool = False,
+                              shard_heads="auto"):
     """Sequence-parallel exact attention with flash-kernel hops.
 
     Same contract and sharding as
     :func:`ops.ring_attention.make_ring_attention` — ``(batch, seq,
-    heads, head_dim)`` with ``seq`` sharded over the trial's data axis —
-    but the per-hop block computation is the Pallas kernel, so no
-    device ever materializes even a ``(T/N, T/N)`` score block in HBM.
-    This is the composition the long-context design is built around:
-    ICI ring for the cross-chip half, VMEM blocking for the
-    within-chip half. Compiled functions are memoized per
-    ``(mesh, causal)`` like :func:`make_ring_attention`; without
-    Pallas the plain ring (HBM-block hops) is returned instead.
+    heads, head_dim)`` with ``seq`` sharded over the trial's data axis,
+    and on a 2-D ``(data x model)`` mesh heads additionally sharded
+    over the model axis (``shard_heads="auto"``) — but the per-hop
+    block computation is the Pallas kernel, so no device ever
+    materializes even a ``(T/N, T/N)`` score block in HBM. This is the
+    composition the long-context design is built around: ICI ring for
+    the cross-chip half, VMEM blocking for the within-chip half.
+    Compiled functions are memoized per ``(mesh, causal, head_axis)``
+    like :func:`make_ring_attention`; without Pallas the plain ring
+    (HBM-block hops) is returned instead. The returned callable
+    exposes ``.head_sharded``.
     """
+    from multidisttorch_tpu.ops.ring_attention import (
+        _resolve_head_axis,
+        _wrap_head_check,
+    )
     from multidisttorch_tpu.parallel.mesh import TrialMesh
 
     if not _HAVE_PALLAS:
         from multidisttorch_tpu.ops.ring_attention import make_ring_attention
 
-        return make_ring_attention(trial, causal=causal)
+        return make_ring_attention(trial, causal=causal,
+                                   shard_heads=shard_heads)
     mesh = trial.mesh if isinstance(trial, TrialMesh) else trial
-    return _make_ring_flash_cached(mesh, causal)
+    head_axis = _resolve_head_axis(mesh, shard_heads)
+    return _wrap_head_check(
+        _make_ring_flash_cached(mesh, causal, head_axis), mesh, head_axis
+    )

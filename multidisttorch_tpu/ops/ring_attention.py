@@ -54,8 +54,16 @@ def _attention_block(q, k, v, q_pos, k_pos, m, l, acc, *, causal, scale):
     return m_new, l_new, acc_new
 
 
-def _ring_attention_local(q, k, v, *, axis_name, num_devices, causal, scale):
-    """Per-device body under shard_map: local Q stays put, K/V rotate."""
+def _ring_attention_local(
+    q, k, v, *, axis_name, num_devices, causal, scale, vary_axes=None
+):
+    """Per-device body under shard_map: local Q stays put, K/V rotate.
+
+    ``vary_axes`` lists every mesh axis the operands vary over — just
+    the ring axis in 1-D mode, plus the model axis when heads are
+    sharded (2-D sequence x head parallelism). The body itself is
+    oblivious to the head count: attention is per-head local math.
+    """
     my_idx = jax.lax.axis_index(axis_name)
     t_local = q.shape[1]
     q_pos = my_idx * t_local + jnp.arange(t_local)
@@ -66,9 +74,10 @@ def _ring_attention_local(q, k, v, *, axis_name, num_devices, causal, scale):
     # to carry the axis annotation already.
     from multidisttorch_tpu.parallel.collectives import pvary
 
-    m0 = pvary(jnp.full((b, h, t_local), -jnp.inf, jnp.float32), axis_name)
-    l0 = pvary(jnp.zeros((b, h, t_local), jnp.float32), axis_name)
-    acc0 = pvary(jnp.zeros((b, t_local, h, d), jnp.float32), axis_name)
+    axes = vary_axes if vary_axes is not None else (axis_name,)
+    m0 = pvary(jnp.full((b, h, t_local), -jnp.inf, jnp.float32), axes)
+    l0 = pvary(jnp.zeros((b, h, t_local), jnp.float32), axes)
+    acc0 = pvary(jnp.zeros((b, t_local, h, d), jnp.float32), axes)
 
     def body(step, carry):
         k_blk, v_blk, m, l, acc = carry
@@ -100,9 +109,14 @@ def _ring_attention_local(q, k, v, *, axis_name, num_devices, causal, scale):
 
 
 @lru_cache(maxsize=None)
-def _make_ring_attention_cached(mesh: Mesh, axis_name: str, causal: bool):
+def _make_ring_attention_cached(
+    mesh: Mesh, axis_name: str, causal: bool, head_axis: str | None = None
+):
     num_devices = int(mesh.shape[axis_name])
-    spec = P(None, axis_name, None, None)  # shard the sequence dim
+    # sequence sharded over the ring axis; heads over the model axis
+    # when 2-D (sequence x head) parallelism is on
+    spec = P(None, axis_name, head_axis, None)
+    vary_axes = (axis_name,) + ((head_axis,) if head_axis else ())
 
     def fn(q, k, v):
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -113,6 +127,7 @@ def _make_ring_attention_cached(mesh: Mesh, axis_name: str, causal: bool):
                 num_devices=num_devices,
                 causal=causal,
                 scale=scale,
+                vary_axes=vary_axes,
             ),
             mesh=mesh,
             in_specs=(spec, spec, spec),
@@ -122,16 +137,61 @@ def _make_ring_attention_cached(mesh: Mesh, axis_name: str, causal: bool):
     return jax.jit(fn)
 
 
-def make_ring_attention(trial: TrialMesh | Mesh, *, causal: bool = False):
+def _resolve_head_axis(mesh: Mesh, shard_heads) -> str | None:
+    """Shared by ring and ring-flash: which mesh axis (if any) shards
+    the head dimension. ``"auto"`` shards whenever the trial actually
+    has a model axis — the 2-D (sequence x head) configuration."""
+    from multidisttorch_tpu.parallel.mesh import MODEL_AXIS
+
+    m = int(dict(mesh.shape).get(MODEL_AXIS, 1))
+    if shard_heads == "auto":
+        return MODEL_AXIS if m > 1 else None
+    if shard_heads:
+        if m <= 1:
+            raise ValueError(
+                "shard_heads=True needs a model axis on the trial mesh "
+                "(setup_groups(model_parallel=...))"
+            )
+        return MODEL_AXIS
+    return None
+
+
+def _wrap_head_check(inner, mesh: Mesh, head_axis: str | None):
+    """Shared by ring and ring-flash entry points: validate head
+    divisibility at call time and expose ``.head_sharded``."""
+    m = int(mesh.shape[head_axis]) if head_axis else 1
+
+    def fn(q, k, v):
+        if head_axis and q.shape[2] % m:
+            raise ValueError(
+                f"heads={q.shape[2]} not divisible by the model axis "
+                f"({m}); pass shard_heads=False or adjust the model"
+            )
+        return inner(q, k, v)
+
+    fn.head_sharded = head_axis is not None
+    return fn
+
+
+def make_ring_attention(
+    trial: TrialMesh | Mesh, *, causal: bool = False, shard_heads="auto"
+):
     """Compiled sequence-parallel attention over a trial's device axis.
 
     Returns ``fn(q, k, v) -> out`` for arrays of shape ``(batch, seq,
-    heads, head_dim)`` with ``seq`` divisible by the submesh size; the
-    sequence dimension is sharded across the axis, and the result is
-    numerically exact attention (fp32 accumulation).
+    heads, head_dim)`` with ``seq`` divisible by the data-axis extent;
+    the sequence dimension is sharded across the ring, and the result
+    is numerically exact attention (fp32 accumulation). On a 2-D
+    ``(data x model)`` trial mesh, heads additionally shard over the
+    model axis (``shard_heads="auto"``; heads must divide it) — the
+    sequence x head parallel configuration that composes with
+    ``transformer_tp_shardings``'s attention-column shards. The
+    returned callable exposes ``.head_sharded`` for introspection.
     """
     mesh = trial.mesh if isinstance(trial, TrialMesh) else trial
-    return _make_ring_attention_cached(mesh, DATA_AXIS, causal)
+    head_axis = _resolve_head_axis(mesh, shard_heads)
+    inner = _make_ring_attention_cached(mesh, DATA_AXIS, causal, head_axis)
+    return _wrap_head_check(inner, mesh, head_axis)
 
 
 def dense_attention_reference(q, k, v, *, causal: bool = False):

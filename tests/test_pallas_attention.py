@@ -157,6 +157,34 @@ def test_ring_flash_gradient_matches_dense():
         )
 
 
+def test_ring_flash_2d_sequence_x_head_parallel():
+    # (data x model) mesh: flash-kernel hops with heads sharded over
+    # the model axis — kernel grid rows shrink to BH/m per device.
+    from multidisttorch_tpu.ops.pallas_attention import (
+        make_ring_flash_attention,
+    )
+    from multidisttorch_tpu.parallel.mesh import setup_groups
+
+    (trial,) = setup_groups(1, model_parallel=2)
+    q, k, v = _qkv(b=2, t=16, h=4, d=8, seed=11)
+    ring = make_ring_flash_attention(trial, causal=True)
+    assert ring.head_sharded
+    out = ring(q, k, v)
+    ref = dense_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-5
+    )
+    g = jax.grad(lambda q: jnp.sum(ring(q, k, v) ** 2))(q)
+    g_ref = jax.grad(
+        lambda q: jnp.sum(
+            dense_attention_reference(q, k, v, causal=True) ** 2
+        )
+    )(q)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(g_ref), rtol=5e-4, atol=5e-5
+    )
+
+
 def test_ring_flash_drives_sequence_parallel_lm():
     # End to end: the TransformerLM trains sequence-parallel with
     # ring-flash as its attention — loss decreases over steps.

@@ -55,6 +55,47 @@ def test_two_trials_run_ring_attention_concurrently():
         )
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_2d_sequence_x_head_parallel_matches_dense(causal):
+    # (data=4 x model=2) mesh: the sequence rides the ring while heads
+    # shard over the model axis — the 2-D attention configuration that
+    # composes with transformer_tp_shardings. Values AND grads exact.
+    (trial,) = setup_groups(1, model_parallel=2)  # data 4 x model 2
+    rng = np.random.default_rng(7)
+    q, k, v = _qkv(rng, t=16, h=4)  # t div 4, heads div 2
+    ring = make_ring_attention(trial, causal=causal)
+    assert ring.head_sharded
+    out = ring(q, k, v)
+    ref = dense_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+    )
+    g = jax.grad(lambda q: jnp.sum(ring(q, k, v) ** 2))(q)
+    g_ref = jax.grad(
+        lambda q: jnp.sum(dense_attention_reference(q, k, v,
+                                                    causal=causal) ** 2)
+    )(q)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(g_ref), rtol=5e-5, atol=5e-6
+    )
+
+
+def test_2d_head_divisibility_checked():
+    (trial,) = setup_groups(1, model_parallel=2)
+    rng = np.random.default_rng(8)
+    q, k, v = _qkv(rng, t=16, h=3)  # 3 heads don't divide model=2
+    ring = make_ring_attention(trial)
+    with pytest.raises(ValueError, match="not divisible"):
+        ring(q, k, v)
+    # explicit opt-out replicates heads and still matches dense
+    flat = make_ring_attention(trial, shard_heads=False)
+    np.testing.assert_allclose(
+        np.asarray(flat(q, k, v)),
+        np.asarray(dense_attention_reference(q, k, v)),
+        rtol=2e-5, atol=2e-6,
+    )
+
+
 def test_extreme_logits_stable():
     trial = setup_groups(2)[0]
     rng = np.random.default_rng(3)

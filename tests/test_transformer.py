@@ -331,19 +331,77 @@ def test_transformer_attention_head_tp_matches_replicated():
     )
 
 
-def test_tp_auto_skips_heads_for_ring_attention():
-    # The ring paths run inside shard_map with replicated-head specs, so
-    # "auto" must not shard heads when an explicit attention is set.
+def test_sp_x_tp_lm_matches_replicated():
+    # The full 2-D composition on one (data=4 x model=2) trial mesh:
+    # tokens sequence-sharded over the ring, heads + q/k/v/proj + MLP
+    # pair sharded over the model axis. Three deterministic training
+    # steps must match the fully-replicated dense-attention LM.
+    from multidisttorch_tpu.models.transformer import transformer_tp_shardings
+    from multidisttorch_tpu.train.steps import state_shardings
+
+    cfg = dict(_COMMON, num_heads=4, max_len=16)
+    tokens_np = np.asarray(_tokens(b=8, t=16, seed=9))  # b div 8 devices
+
+    def replicated():
+        (g,) = setup_groups(1)
+        model = TransformerLM(**cfg)
+        tx = optax.adam(1e-3)
+        state = create_lm_state(g, model, tx, jax.random.key(0),
+                                example_len=16)
+        step = make_lm_train_step(g, model, tx)  # plain DP over batch
+        toks = jax.device_put(jnp.asarray(tokens_np), g.batch_sharding)
+        out = []
+        for _ in range(3):
+            state, m = step(state, toks)
+            out.append(float(m["loss"]))
+        return out
+
+    def composed():
+        (g,) = setup_groups(1, model_parallel=2)  # data 4 x model 2
+        ring = make_ring_attention(g, causal=True)
+        assert ring.head_sharded
+        model = TransformerLM(attention=ring, **cfg)
+        tx = optax.adam(1e-3)
+        psh = transformer_tp_shardings(g, model)
+        state = create_lm_state(
+            g, model, tx, jax.random.key(0), example_len=16,
+            param_shardings=psh,
+        )
+        step = make_lm_train_step(
+            g, model, tx, sequence_parallel=True,
+            shardings=state_shardings(state),
+        )
+        toks = jax.device_put(jnp.asarray(tokens_np),
+                              g.sharding(None, DATA_AXIS))
+        out = []
+        for _ in range(3):
+            state, m = step(state, toks)
+            out.append(float(m["loss"]))
+        return out
+
+    np.testing.assert_allclose(replicated(), composed(), rtol=2e-4)
+
+
+def test_tp_auto_follows_ring_head_sharding():
+    # "auto" follows the attention callable: a head-sharded ring (2-D
+    # mesh, shard_heads default) gets sharded q/k/v projections; a
+    # replicated-head ring (shard_heads=False) keeps them replicated.
+    # The MLP pair shards either way.
     from multidisttorch_tpu.models.transformer import transformer_tp_shardings
     from multidisttorch_tpu.parallel.mesh import MODEL_AXIS
 
     (g,) = setup_groups(1, model_parallel=4)
-    ring_model = TransformerLM(
-        attention=make_ring_attention(g, causal=True),
-        **dict(_COMMON, num_heads=4),
-    )
-    sh = transformer_tp_shardings(g, ring_model)
-    q_spec = sh["block_0"]["q"]["kernel"].spec
-    up_spec = sh["block_0"]["up"]["kernel"].spec
-    assert MODEL_AXIS not in tuple(q_spec)  # heads replicated
-    assert MODEL_AXIS in tuple(up_spec)  # MLP still sharded
+    cfg = dict(_COMMON, num_heads=4)
+
+    sharded_ring = make_ring_attention(g, causal=True)
+    assert sharded_ring.head_sharded
+    sh = transformer_tp_shardings(g, TransformerLM(attention=sharded_ring,
+                                                   **cfg))
+    assert MODEL_AXIS in tuple(sh["block_0"]["q"]["kernel"].spec)
+
+    flat_ring = make_ring_attention(g, causal=True, shard_heads=False)
+    assert not flat_ring.head_sharded
+    sh = transformer_tp_shardings(g, TransformerLM(attention=flat_ring,
+                                                   **cfg))
+    assert MODEL_AXIS not in tuple(sh["block_0"]["q"]["kernel"].spec)
+    assert MODEL_AXIS in tuple(sh["block_0"]["up"]["kernel"].spec)
