@@ -6,10 +6,16 @@ the job into N submeshes, and inside EACH one train a causal
 TransformerLM with its context sharded T/k over that submesh's ring
 (ring or ring-flash attention). Trials sweep the learning rate and run
 under the same cooperative no-barrier dispatch as every other sweep.
+``--model-parallel m`` adds a third axis: each trial's submesh becomes
+(data x model), heads + q/k/v/proj + the MLP pair shard over the model
+axis (2-D sequence x head attention) — trial x sequence x tensor
+parallelism in one sweep.
 
 Run (8 virtual CPU devices — two 4-device rings):
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python examples/lm_hpo.py --ngroups 2 --seq-len 128 --steps 40
+    # two (2-ring x 2-TP) trials:
+    ... python examples/lm_hpo.py --ngroups 2 --seq-len 64 --model-parallel 2
 """
 
 import argparse
@@ -51,14 +57,27 @@ def main():
         help="flash-kernel hops (ops/pallas_attention.py) inside each "
         "trial's K/V ring",
     )
+    parser.add_argument(
+        "--model-parallel", type=int, default=1,
+        help="model-axis extent per trial: heads + q/k/v/proj + MLP "
+        "pair shard over it (2-D sequence x head attention), composing "
+        "trial x sequence x tensor parallelism in one sweep",
+    )
     args = parser.parse_args()
 
     mdt.initialize_runtime()
-    groups = mdt.setup_groups(args.ngroups)
-    if args.seq_len % groups[0].size:
+    if args.model_parallel > 1 and 4 % args.model_parallel:
+        # TransformerLM's default head count; ring head sharding needs
+        # whole heads per model-axis device
         parser.error(
-            f"--seq-len must divide by {groups[0].size} "
-            f"(devices per {args.ngroups}-group trial)"
+            f"--model-parallel {args.model_parallel} must divide the "
+            f"model's 4 attention heads"
+        )
+    groups = mdt.setup_groups(args.ngroups, model_parallel=args.model_parallel)
+    if args.seq_len % groups[0].data_size:
+        parser.error(
+            f"--seq-len must divide by {groups[0].data_size} "
+            f"(ring devices per {args.ngroups}-group trial)"
         )
     if args.ring_flash:
         from multidisttorch_tpu.ops.pallas_attention import (
@@ -86,22 +105,35 @@ def main():
             attention=make_attn(g, causal=True),
         )
         tx = optax.adam(lr)
+        psh = sh = None
+        if args.model_parallel > 1:
+            from multidisttorch_tpu.models.transformer import (
+                transformer_tp_shardings,
+            )
+            from multidisttorch_tpu.train.steps import state_shardings
+
+            psh = transformer_tp_shardings(g, model)
         rows = [
             (base[: args.seq_len] + g.group_id + 2 * r) % args.vocab
             for r in range(args.batch_size)
         ]
+        state = create_lm_state(
+            g, model, tx, jax.random.key(g.group_id),
+            example_len=args.seq_len, param_shardings=psh,
+        )
+        if psh is not None:
+            sh = state_shardings(state)
         trials.append(
             {
                 "trial": g,
                 "lr": lr,
-                "state": create_lm_state(
-                    g, model, tx, jax.random.key(g.group_id),
-                    example_len=args.seq_len,
-                ),
+                "state": state,
                 "step": make_lm_train_step(
-                    g, model, tx, sequence_parallel=True
+                    g, model, tx, sequence_parallel=True, shardings=sh
                 ),
-                "eval": make_lm_eval_step(g, model, sequence_parallel=True),
+                "eval": make_lm_eval_step(
+                    g, model, sequence_parallel=True, shardings=sh
+                ),
                 # g.device_put (not jax.device_put): on a process-
                 # spanning submesh each owner feeds only its
                 # addressable shards
@@ -113,10 +145,16 @@ def main():
         )
 
     kind = "ring-flash" if args.ring_flash else "ring"
-    per_dev = args.seq_len // groups[0].size
+    per_dev = args.seq_len // groups[0].data_size
+    tp = (
+        f" x {args.model_parallel}-way tensor/head parallel"
+        if args.model_parallel > 1
+        else ""
+    )
     mdt.log0(
         f"{len(groups)} concurrent {kind} trials; {args.seq_len} tokens "
-        f"({per_dev}/device inside each {groups[0].size}-device ring)"
+        f"({per_dev}/device inside each {groups[0].data_size}-device "
+        f"ring){tp}"
     )
 
     # Cooperative round-robin: one step per trial per cycle, no barriers.
