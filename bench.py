@@ -14,6 +14,7 @@ Prints exactly ONE JSON line:
 vs_baseline = our throughput / reference-loop throughput.
 """
 
+import contextlib
 import json
 import subprocess
 import sys
@@ -373,6 +374,7 @@ def _timed_chunks(trial, model, tx, **step_kwargs) -> float:
     bench_to_elbo measure deliberately different things (interleaved
     multi-trial dispatch; loss-gated wall-clock) with their own loops."""
     from multidisttorch_tpu.train.steps import create_train_state, make_multi_step
+    from multidisttorch_tpu.utils.profiling import profile_trace
 
     state = create_train_state(trial, model, tx, jax.random.key(0))
     multi = make_multi_step(trial, model, tx, **step_kwargs)
@@ -387,15 +389,26 @@ def _timed_chunks(trial, model, tx, **step_kwargs) -> float:
     key = jax.random.key(1)
     state, _ = multi(state, batches, key)  # compile + warmup
     jax.block_until_ready(state.params)
+    # MDT_BENCH_TRACE=<dir>: wrap the first timed pass in a JAX
+    # profiler trace (TensorBoard/Perfetto-loadable; device timelines
+    # on TPU) — evidence for where a bad number comes from.
+    trace_dir = os.environ.get("MDT_BENCH_TRACE")
     rates = []
     for r in range(MEASURE_REPEATS):
-        t0 = time.perf_counter()
-        for i in range(MEASURE_CHUNKS):
-            state, _ = multi(
-                state, batches, jax.random.fold_in(key, r * MEASURE_CHUNKS + i)
-            )
-        jax.block_until_ready(state.params)
-        dt = time.perf_counter() - t0
+        ctx = (
+            profile_trace(trace_dir)
+            if trace_dir and r == 0
+            else contextlib.nullcontext()
+        )
+        with ctx:
+            t0 = time.perf_counter()
+            for i in range(MEASURE_CHUNKS):
+                state, _ = multi(
+                    state, batches,
+                    jax.random.fold_in(key, r * MEASURE_CHUNKS + i),
+                )
+            jax.block_until_ready(state.params)
+            dt = time.perf_counter() - t0
         rates.append(MEASURE_CHUNKS * CHUNK_STEPS * BATCH / dt)
     return float(np.median(rates))
 
