@@ -4,6 +4,7 @@ from multidisttorch_tpu.train.lm import (
     make_lm_eval_step,
     make_lm_train_step,
 )
+from multidisttorch_tpu.train.lm_pipeline import make_pipelined_lm
 from multidisttorch_tpu.train.steps import (
     TrainState,
     create_train_state,
