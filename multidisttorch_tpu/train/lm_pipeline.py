@@ -102,16 +102,17 @@ def make_pipelined_lm(
         )
 
     stages = _stage_layers(model.num_layers, num_stages)
-    # Stages run in float32 regardless of the model's compute dtype:
-    # the pipeline's packed-params/padded-carry contract is f32
-    # (parallel/pipeline.py pack_stage_params). model.remat carries
-    # over: per-block checkpointing composes with the staged schedule.
+    # Stages compute at the model's own dtype (params stay f32 per the
+    # pipeline's packing contract; the inter-stage carry is an f32
+    # buffer, so a bf16 model pays one cast per stage boundary — the
+    # within-stage math is unchanged). model.remat carries over:
+    # per-block checkpointing composes with the staged schedule.
     block_cls = nn.remat(Block) if model.remat else Block
     block_mod = block_cls(
         d_model=model.d_model,
         num_heads=model.num_heads,
         attention=attn,
-        dtype=jnp.float32,
+        dtype=model.dtype,
     )
 
     def stage_fn(layer_ids):
@@ -133,7 +134,7 @@ def make_pipelined_lm(
     outer = {
         k: params[k] for k in ("tok_embed", "pos_embed", "ln_out", "head")
     }
-    ln = nn.LayerNorm(dtype=jnp.float32, param_dtype=jnp.float32)
+    ln = nn.LayerNorm(dtype=model.dtype, param_dtype=jnp.float32)
 
     def apply(packed_arr, outer_params, tokens):
         _, t = tokens.shape
@@ -145,13 +146,17 @@ def make_pipelined_lm(
             )
         x = jnp.take(
             outer_params["tok_embed"]["embedding"], tokens, axis=0
-        ).astype(jnp.float32)
+        ).astype(model.dtype)
         x = x + jnp.take(
             outer_params["pos_embed"]["embedding"], jnp.arange(t), axis=0
-        ).astype(jnp.float32)[None, :, :]
+        ).astype(model.dtype)[None, :, :]
         x = pp_apply(packed_arr, x)
         x = ln.apply({"params": outer_params["ln_out"]}, x)
-        return x @ outer_params["head"]["kernel"] + outer_params["head"]["bias"]
+        # head computes in f32, matching TransformerLM's own head Dense
+        return (
+            x.astype(jnp.float32) @ outer_params["head"]["kernel"]
+            + outer_params["head"]["bias"]
+        )
 
     return apply, packed, outer
 

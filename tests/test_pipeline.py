@@ -336,6 +336,36 @@ def test_pipelined_lm_matches_plain_model():
     )
 
 
+def test_pipelined_lm_bf16_close_to_plain_model():
+    # A bf16 model keeps its compute dtype inside the stages; the f32
+    # inter-stage carry costs one cast per boundary, so parity is
+    # approximate at bf16 storage precision, not bitwise.
+    from multidisttorch_tpu.models.transformer import TransformerLM
+    from multidisttorch_tpu.train.lm_pipeline import (
+        make_pipelined_lm,
+        stage_params_sharding,
+    )
+
+    (trial,) = setup_groups(1, pipeline_parallel=2)
+    model = TransformerLM(
+        vocab_size=32, d_model=16, num_heads=2, num_layers=2, max_len=16,
+        dtype=jnp.bfloat16,
+    )
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, 32, (8, 16), dtype=np.int32)
+    )
+    params = model.init({"params": jax.random.key(0)}, tokens)["params"]
+    apply, packed, outer = make_pipelined_lm(
+        trial, model, params, num_microbatches=2
+    )
+    packed = jax.device_put(packed, stage_params_sharding(trial))
+    got = apply(packed, outer, tokens)
+    want = model.apply({"params": params}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=5e-2, atol=5e-2
+    )
+
+
 def test_pipelined_lm_trains_dp_x_pp():
     # One jitted Adam step over (packed, outer) — DP x PP from a single
     # program; next-token loss falls on the periodic corpus.
