@@ -6,7 +6,9 @@ from multidisttorch_tpu.models.resnet import (
     resnet_tp_shardings,
 )
 from multidisttorch_tpu.models.transformer import (
+    MoETransformerLM,
     TransformerLM,
+    moe_lm_ep_shardings,
     transformer_tp_shardings,
 )
 from multidisttorch_tpu.models.vae import VAE, init_vae_params, vae_tp_shardings

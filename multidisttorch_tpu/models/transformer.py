@@ -28,6 +28,38 @@ import jax.numpy as jnp
 from multidisttorch_tpu.ops.ring_attention import dense_attention_reference
 
 
+def _layer_ctors(mod):
+    """The dense/layernorm constructors every block variant shares
+    (compute at ``mod.dtype``, params f32)."""
+    dense = lambda feats, name: nn.Dense(
+        feats, dtype=mod.dtype, param_dtype=jnp.float32, name=name
+    )
+    ln = lambda name: nn.LayerNorm(
+        dtype=mod.dtype, param_dtype=jnp.float32, name=name
+    )
+    return dense, ln
+
+
+def _attention_residual(mod, x, dense, ln):
+    """The attention half shared by :class:`Block` and
+    :class:`MoEBlock` (one copy — the two must never drift).
+
+    Separate q/k/v projections (not one fused 3d dense): each output's
+    flat feature dim factors as [head, head_dim], so a tensor-parallel
+    column sharding of the kernel IS a head sharding after the reshape
+    — no resharding at the reshape, which the fused layout (proj-major
+    [3, head, dh]) can't offer.
+    """
+    b, t, d = x.shape
+    h = mod.num_heads
+    y = ln("ln_attn")(x)
+    q = dense(d, "q")(y).reshape(b, t, h, d // h)
+    k = dense(d, "k")(y).reshape(b, t, h, d // h)
+    v = dense(d, "v")(y).reshape(b, t, h, d // h)
+    attn = mod.attention(q, k, v).reshape(b, t, d)
+    return x + dense(d, "proj")(attn)
+
+
 class Block(nn.Module):
     """Pre-LN decoder block: attention + 4x GELU MLP, both residual."""
 
@@ -38,31 +70,62 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        b, t, d = x.shape
-        h = self.num_heads
-        dense = lambda feats, name: nn.Dense(
-            feats, dtype=self.dtype, param_dtype=jnp.float32, name=name
-        )
-        ln = lambda name: nn.LayerNorm(
-            dtype=self.dtype, param_dtype=jnp.float32, name=name
-        )
-
-        y = ln("ln_attn")(x)
-        # Separate q/k/v projections (not one fused 3d dense): each
-        # output's flat feature dim factors as [head, head_dim], so a
-        # tensor-parallel column sharding of the kernel IS a head
-        # sharding after the reshape — no resharding at the reshape,
-        # which the fused layout (proj-major [3, head, dh]) can't offer.
-        q = dense(d, "q")(y).reshape(b, t, h, d // h)
-        k = dense(d, "k")(y).reshape(b, t, h, d // h)
-        v = dense(d, "v")(y).reshape(b, t, h, d // h)
-        attn = self.attention(q, k, v).reshape(b, t, d)
-        x = x + dense(d, "proj")(attn)
-
+        dense, ln = _layer_ctors(self)
+        x = _attention_residual(self, x, dense, ln)
+        d = x.shape[-1]
         y = ln("ln_mlp")(x)
         y = dense(4 * d, "up")(y)
         y = nn.gelu(y)
         return x + dense(d, "down")(y)
+
+
+def _default_causal(attn):
+    """The dense causal reference when no attention was injected."""
+    if attn is not None:
+        return attn
+    return lambda q, k, v: dense_attention_reference(q, k, v, causal=True)
+
+
+def _lm_embed(mod, tokens):
+    """Token + learned positional embeddings, shared by both LM
+    variants — includes the trace-time length check (out-of-range
+    nn.Embed gathers would silently clip/fill, not raise)."""
+    _, t = tokens.shape
+    if t > mod.max_len:
+        raise ValueError(f"sequence length {t} exceeds max_len={mod.max_len}")
+    x = nn.Embed(
+        mod.vocab_size, mod.d_model, dtype=mod.dtype,
+        param_dtype=jnp.float32, name="tok_embed",
+    )(tokens)
+    pos = nn.Embed(
+        mod.max_len, mod.d_model, dtype=mod.dtype,
+        param_dtype=jnp.float32, name="pos_embed",
+    )(jnp.arange(t)[None, :])
+    return x + pos
+
+
+def _lm_head(mod, x):
+    """Final norm + f32 vocab head, shared by both LM variants."""
+    x = nn.LayerNorm(
+        dtype=mod.dtype, param_dtype=jnp.float32, name="ln_out"
+    )(x)
+    return nn.Dense(
+        mod.vocab_size, dtype=jnp.float32, param_dtype=jnp.float32,
+        name="head",
+    )(x)
+
+
+def _lm_param_shapes(trial, model):
+    """Abstract param shapes for a sharding builder. The dummy length
+    must divide the trial's data-axis extent or a ring-attention
+    model's shard_map fails inside eval_shape (same constraint
+    create_lm_state solves the same way)."""
+    dummy_len = min(8 * trial.data_size, model.max_len)
+    return jax.eval_shape(
+        model.init,
+        {"params": jax.random.key(0)},
+        jnp.zeros((1, dummy_len), jnp.int32),
+    )["params"]
 
 
 class TransformerLM(nn.Module):
@@ -92,27 +155,8 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens):
-        b, t = tokens.shape
-        if t > self.max_len:
-            # Trace-time check (t is a static shape): out-of-range
-            # nn.Embed gathers would silently clip/fill, not raise.
-            raise ValueError(
-                f"sequence length {t} exceeds max_len={self.max_len}"
-            )
-        attn = self.attention
-        if attn is None:
-            attn = lambda q, k, v: dense_attention_reference(
-                q, k, v, causal=True
-            )
-        x = nn.Embed(
-            self.vocab_size, self.d_model, dtype=self.dtype,
-            param_dtype=jnp.float32, name="tok_embed",
-        )(tokens)
-        pos = nn.Embed(
-            self.max_len, self.d_model, dtype=self.dtype,
-            param_dtype=jnp.float32, name="pos_embed",
-        )(jnp.arange(t)[None, :])
-        x = x + pos
+        x = _lm_embed(self, tokens)
+        attn = _default_causal(self.attention)
         block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.num_layers):
             x = block_cls(
@@ -122,13 +166,7 @@ class TransformerLM(nn.Module):
                 dtype=self.dtype,
                 name=f"block_{i}",
             )(x)
-        x = nn.LayerNorm(
-            dtype=self.dtype, param_dtype=jnp.float32, name="ln_out"
-        )(x)
-        return nn.Dense(
-            self.vocab_size, dtype=jnp.float32, param_dtype=jnp.float32,
-            name="head",
-        )(x)
+        return _lm_head(self, x)
 
 
 def transformer_tp_shardings(
@@ -185,16 +223,7 @@ def transformer_tp_shardings(
         "bias": trial.sharding(),
     }
     repl = trial.sharding()
-
-    # Dummy length must divide the trial's data-axis extent or a
-    # ring-attention model's shard_map fails inside eval_shape (same
-    # constraint create_lm_state solves the same way).
-    dummy_len = min(8 * trial.data_size, model.max_len)
-    shapes = jax.eval_shape(
-        model.init,
-        {"params": jax.random.key(0)},
-        jnp.zeros((1, dummy_len), jnp.int32),
-    )["params"]
+    shapes = _lm_param_shapes(trial, model)
 
     col_names = {"up"} | ({"q", "k", "v"} if shard_attention else set())
     row_names = {"down"} | ({"proj"} if shard_attention else set())
@@ -209,3 +238,92 @@ def transformer_tp_shardings(
         return repl
 
     return jax.tree_util.tree_map_with_path(rule, shapes)
+
+
+class MoEBlock(nn.Module):
+    """Pre-LN decoder block whose MLP is a top-1-routed expert mixture.
+
+    Same attention half as :class:`Block`; the 4x GELU MLP is replaced
+    by :class:`ops.moe.MoEMLP` (GShard static dispatch — SURVEY.md §2c
+    has no MoE anywhere in the reference). Returns ``(x, aux)`` so the
+    Switch load-balancing loss can reach the objective.
+    """
+
+    d_model: int
+    num_heads: int
+    attention: Callable
+    num_experts: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        from multidisttorch_tpu.ops.moe import MoEMLP
+
+        dense, ln = _layer_ctors(self)
+        x = _attention_residual(self, x, dense, ln)
+        b, t, d = x.shape
+        y = ln("ln_mlp")(x)
+        # MoEMLP routes per token: flatten (B, T, d) -> (B*T, d)
+        y2, aux = MoEMLP(
+            num_experts=self.num_experts,
+            hidden_dim=4 * d,
+            out_dim=d,
+            capacity_factor=self.capacity_factor,
+            dtype=self.dtype,
+            name="moe",
+        )(y.reshape(b * t, d))
+        return x + y2.reshape(b, t, d), aux
+
+
+class MoETransformerLM(nn.Module):
+    """Decoder-only LM with expert-parallel MoE MLPs in every block.
+
+    ``(B, T) int32 tokens -> ((B, T, vocab) logits, aux)`` where
+    ``aux`` is the mean Switch load-balancing loss over blocks. Expert
+    parallelism is a sharding: place params with
+    :func:`moe_lm_ep_shardings` and each device of the trial's model
+    axis runs only its experts.
+    """
+
+    vocab_size: int
+    d_model: int = 64
+    num_heads: int = 4
+    num_layers: int = 2
+    num_experts: int = 4
+    capacity_factor: float = 1.25
+    max_len: int = 256
+    attention: Optional[Callable] = None
+    dtype: Any = jnp.float32
+    remat: bool = False  # per-block checkpointing, as in TransformerLM
+
+    @nn.compact
+    def __call__(self, tokens):
+        x = _lm_embed(self, tokens)
+        attn = _default_causal(self.attention)
+        block_cls = nn.remat(MoEBlock) if self.remat else MoEBlock
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(self.num_layers):
+            x, aux = block_cls(
+                d_model=self.d_model,
+                num_heads=self.num_heads,
+                attention=attn,
+                num_experts=self.num_experts,
+                capacity_factor=self.capacity_factor,
+                dtype=self.dtype,
+                name=f"block_{i}",
+            )(x)
+            aux_total = aux_total + aux
+        logits = _lm_head(self, x)
+        return logits, aux_total / self.num_layers
+
+
+def moe_lm_ep_shardings(trial, model: MoETransformerLM):
+    """Expert-parallel shardings for the MoE LM: every expert-indexed
+    leaf (the blocks' ``moe/w1|b1|w2|b2``) splits over the trial's
+    ``model`` axis via the one shared rule
+    (:func:`ops.moe.moe_ep_shardings`); attention projections, router,
+    embeddings, norms, and the head stay replicated."""
+    from multidisttorch_tpu.ops.moe import moe_ep_shardings
+
+    return moe_ep_shardings(trial, _lm_param_shapes(trial, model))

@@ -58,6 +58,7 @@ def make_lm_train_step(
     *,
     sequence_parallel: bool = False,
     shardings: Any = None,
+    aux_loss_weight: float = 1e-2,
 ) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict]]:
     """``step(state, tokens) -> (state, {loss})`` — ``tokens`` is
     ``(B, T) int32``; with ``sequence_parallel`` the T dimension is
@@ -66,16 +67,20 @@ def make_lm_train_step(
     model with ``TransformerLM(remat=True)`` — per-BLOCK checkpointing,
     the placement that actually cuts peak HBM (a whole-forward
     ``jax.checkpoint`` here would recompute everything and save
-    nothing)."""
+    nothing). A model returning ``(logits, aux)`` (the MoE LM's Switch
+    load-balancing term) trains on
+    ``lm_loss + aux_loss_weight * aux``."""
     repl, tokens_sh, state_sh = _lm_shardings(
         trial, sequence_parallel, shardings
     )
 
     def step_fn(state: TrainState, tokens: jax.Array):
         def loss_fn(params):
-            return lm_loss_mean(
-                model.apply({"params": params}, tokens), tokens
-            )
+            out = model.apply({"params": params}, tokens)
+            if isinstance(out, tuple):
+                logits, aux = out
+                return lm_loss_mean(logits, tokens) + aux_loss_weight * aux
+            return lm_loss_mean(out, tokens)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
@@ -110,9 +115,9 @@ def make_lm_eval_step(
     )
 
     def eval_fn(state: TrainState, tokens: jax.Array):
-        loss = lm_loss_mean(
-            model.apply({"params": state.params}, tokens), tokens
-        )
+        out = model.apply({"params": state.params}, tokens)
+        logits = out[0] if isinstance(out, tuple) else out
+        loss = lm_loss_mean(logits, tokens)
         return {
             "loss": loss.astype(jnp.float32),
             "perplexity": jnp.exp(loss).astype(jnp.float32),

@@ -185,3 +185,89 @@ def test_moe_vae_expert_parallel_train_step():
         losses.append(float(m["loss_sum"]))
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
+
+
+def test_moe_lm_trains_with_expert_parallelism():
+    # The MoE transformer LM on a (data x model) submesh: experts
+    # physically split over the model axis, Switch aux loss in the
+    # objective, next-token loss falls on the periodic corpus.
+    import optax
+
+    from multidisttorch_tpu.models.transformer import (
+        MoETransformerLM,
+        moe_lm_ep_shardings,
+    )
+    from multidisttorch_tpu.train.lm import create_lm_state, make_lm_train_step
+    from multidisttorch_tpu.train.steps import state_shardings
+
+    (g,) = setup_groups(1, model_parallel=2)  # data 4 x model 2
+    model = MoETransformerLM(
+        vocab_size=16, d_model=16, num_heads=2, num_layers=2,
+        num_experts=2, max_len=16,
+    )
+    tx = optax.adam(3e-3)
+    psh = moe_lm_ep_shardings(g, model)
+    state = create_lm_state(
+        g, model, tx, jax.random.key(0), example_len=16, param_shardings=psh
+    )
+    # expert leaves physically split: E=2 over model axis of 2
+    w1 = state.params["block_0"]["moe"]["w1"]
+    assert w1.shape[0] == 2 and w1.addressable_shards[0].data.shape[0] == 1
+
+    step = make_lm_train_step(
+        g, model, tx, shardings=state_shardings(state)
+    )
+    base = np.tile(np.arange(8), 2)[:16]
+    tokens = jax.device_put(
+        jnp.asarray(
+            np.stack([(base + r) % 16 for r in range(8)]).astype(np.int32)
+        ),
+        g.batch_sharding,
+    )
+    losses = []
+    for _ in range(30):
+        state, m = step(state, tokens)
+        losses.append(float(m["loss"]))
+    assert losses[0] > 1.5
+    assert losses[-1] < losses[0] * 0.5, losses
+
+
+def test_moe_lm_composes_with_sequence_parallelism():
+    # EP x SP in one model: ring attention shards the context over the
+    # data axis while the MoE experts shard over the model axis.
+    import optax
+
+    from multidisttorch_tpu.models.transformer import (
+        MoETransformerLM,
+        moe_lm_ep_shardings,
+    )
+    from multidisttorch_tpu.ops.ring_attention import make_ring_attention
+    from multidisttorch_tpu.parallel.mesh import DATA_AXIS
+    from multidisttorch_tpu.train.lm import create_lm_state, make_lm_train_step
+    from multidisttorch_tpu.train.steps import state_shardings
+
+    (g,) = setup_groups(1, model_parallel=2)
+    t = 8 * g.data_size
+    model = MoETransformerLM(
+        vocab_size=16, d_model=16, num_heads=2, num_layers=1,
+        num_experts=2, max_len=t,
+        attention=make_ring_attention(g, causal=True, shard_heads=False),
+    )
+    tx = optax.adam(3e-3)
+    state = create_lm_state(
+        g, model, tx, jax.random.key(0), example_len=t,
+        param_shardings=moe_lm_ep_shardings(g, model),
+    )
+    step = make_lm_train_step(
+        g, model, tx, sequence_parallel=True,
+        shardings=state_shardings(state),
+    )
+    base = np.tile(np.arange(8), t // 8 + 1)[:t]
+    tokens = g.device_put(
+        np.stack([base, (base + 3) % 16]).astype(np.int32),
+        g.sharding(None, DATA_AXIS),
+    )
+    state, m0 = step(state, tokens)
+    for _ in range(25):
+        state, m = step(state, tokens)
+    assert float(m["loss"]) < float(m0["loss"]) * 0.5
