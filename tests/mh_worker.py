@@ -294,6 +294,65 @@ def main():
             "seq_shard_len": 64 // g.size,
         }
 
+    elif mode == "moe_lm_ep_sp":
+        # EP x SP across processes: one (data x model) trial spanning
+        # both processes — the context shards over the data-axis ring
+        # (K/V crossing the process boundary) while the MoE experts
+        # shard over the model axis. SPMD identity + learning.
+        import numpy as np
+        import optax
+
+        from multidisttorch_tpu.models.transformer import (
+            MoETransformerLM,
+            moe_lm_ep_shardings,
+        )
+        from multidisttorch_tpu.ops.ring_attention import make_ring_attention
+        from multidisttorch_tpu.parallel.mesh import DATA_AXIS, setup_groups
+        from multidisttorch_tpu.train.lm import (
+            create_lm_state,
+            make_lm_train_step,
+        )
+        from multidisttorch_tpu.train.steps import state_shardings
+
+        (g,) = setup_groups(1, model_parallel=2)
+        t = 8 * g.data_size
+        model = MoETransformerLM(
+            vocab_size=16, d_model=16, num_heads=2, num_layers=1,
+            num_experts=2, max_len=t,
+            attention=make_ring_attention(g, causal=True,
+                                          shard_heads=False),
+        )
+        tx = optax.adam(3e-3)
+        state = create_lm_state(
+            g, model, tx, jax.random.key(0), example_len=t,
+            param_shardings=moe_lm_ep_shardings(g, model),
+        )
+        step = make_lm_train_step(
+            g, model, tx, sequence_parallel=True,
+            shardings=state_shardings(state),
+        )
+        base = np.tile(np.arange(8), t // 8 + 1)[:t]
+        tokens = g.device_put(
+            np.stack([base, (base + 3) % 16]).astype(np.int32),
+            g.sharding(None, DATA_AXIS),
+        )
+        losses = []
+        for _ in range(25):
+            state, m = step(state, tokens)
+            losses.append(round(float(m["loss"]), 6))
+        w1 = state.params["block_0"]["moe"]["w1"]
+        summary = {
+            "pid": pid,
+            "first_loss": losses[0],
+            "final_loss": losses[-1],
+            "expert_shard": int(w1.addressable_shards[0].data.shape[0]),
+            # measured from the placed array, not recomputed from t —
+            # a mis-carved mesh or replicated tokens must show up here
+            "seq_shard_len": int(
+                tokens.sharding.shard_shape(tokens.shape)[1]
+            ),
+        }
+
     elif mode == "pbt":
         # Cross-process exploit moves weights via broadcast_one_to_all;
         # every process must report identical global decisions.

@@ -233,6 +233,19 @@ def test_sequence_parallel_lm_spans_processes(tmp_path):
 
 
 @pytest.mark.multihost
+def test_moe_lm_ep_x_sp_spans_processes(tmp_path):
+    # One (data=4 x model=2) trial spanning 2 processes: experts split
+    # over the model axis, context ringing over the data axis — the
+    # EP x SP composition under real multi-controller SPMD.
+    r0, r1 = _launch("moe_lm_ep_sp", tmp_path)
+    assert r0["expert_shard"] == 1  # 2 experts / 2-wide model axis
+    assert r0["seq_shard_len"] == 8
+    assert r0["first_loss"] == r1["first_loss"]
+    assert r0["final_loss"] == r1["final_loss"]
+    assert r0["final_loss"] < r0["first_loss"] * 0.5
+
+
+@pytest.mark.multihost
 def test_ring_flash_lm_spans_processes(tmp_path):
     # Same cross-process long-context world through the ring-flash path:
     # each hop's block pair runs the Pallas flash kernel while K/V
