@@ -9,7 +9,9 @@ under the same cooperative no-barrier dispatch as every other sweep.
 ``--model-parallel m`` adds a third axis: each trial's submesh becomes
 (data x model), heads + q/k/v/proj + the MLP pair shard over the model
 axis (2-D sequence x head attention) — trial x sequence x tensor
-parallelism in one sweep.
+parallelism in one sweep. ``--moe E`` swaps in the MoE transformer
+(E experts per block); with ``--model-parallel`` the experts claim the
+model axis instead (trial x sequence x EXPERT parallelism).
 
 Run (8 virtual CPU devices — two 4-device rings):
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
@@ -63,16 +65,30 @@ def main():
         "pair shard over it (2-D sequence x head attention), composing "
         "trial x sequence x tensor parallelism in one sweep",
     )
+    parser.add_argument(
+        "--moe", type=int, default=0, metavar="E",
+        help="use the MoE transformer with E experts per block; with "
+        "--model-parallel the experts shard over the model axis "
+        "(expert parallelism) while the context rides the ring",
+    )
     args = parser.parse_args()
 
     mdt.initialize_runtime()
-    if args.model_parallel > 1 and 4 % args.model_parallel:
-        # TransformerLM's default head count; ring head sharding needs
-        # whole heads per model-axis device
-        parser.error(
-            f"--model-parallel {args.model_parallel} must divide the "
-            f"model's 4 attention heads"
-        )
+    if args.model_parallel > 1:
+        if args.moe:
+            if args.moe % args.model_parallel:
+                parser.error(
+                    f"--model-parallel {args.model_parallel} must "
+                    f"divide the --moe {args.moe} experts (whole "
+                    f"experts per model-axis device)"
+                )
+        elif 4 % args.model_parallel:
+            # TransformerLM's default head count; ring head sharding
+            # needs whole heads per model-axis device
+            parser.error(
+                f"--model-parallel {args.model_parallel} must divide "
+                f"the model's 4 attention heads"
+            )
     groups = mdt.setup_groups(args.ngroups, model_parallel=args.model_parallel)
     if args.seq_len % groups[0].data_size:
         parser.error(
@@ -99,20 +115,36 @@ def main():
     for g, lr in zip(groups, lrs):
         if not g.is_local_member:  # multi-host: skip remote submeshes
             continue
-        model = TransformerLM(
-            vocab_size=args.vocab, d_model=args.d_model,
-            num_layers=args.layers, max_len=args.seq_len,
-            attention=make_attn(g, causal=True),
-        )
+        if args.moe:
+            from multidisttorch_tpu.models.transformer import MoETransformerLM
+
+            # experts claim the model axis, so heads stay replicated
+            model = MoETransformerLM(
+                vocab_size=args.vocab, d_model=args.d_model,
+                num_layers=args.layers, max_len=args.seq_len,
+                num_experts=args.moe,
+                attention=make_attn(g, causal=True, shard_heads=False),
+            )
+        else:
+            model = TransformerLM(
+                vocab_size=args.vocab, d_model=args.d_model,
+                num_layers=args.layers, max_len=args.seq_len,
+                attention=make_attn(g, causal=True),
+            )
         tx = optax.adam(lr)
         psh = sh = None
         if args.model_parallel > 1:
             from multidisttorch_tpu.models.transformer import (
+                moe_lm_ep_shardings,
                 transformer_tp_shardings,
             )
             from multidisttorch_tpu.train.steps import state_shardings
 
-            psh = transformer_tp_shardings(g, model)
+            psh = (
+                moe_lm_ep_shardings(g, model)
+                if args.moe
+                else transformer_tp_shardings(g, model)
+            )
         rows = [
             (base[: args.seq_len] + g.group_id + 2 * r) % args.vocab
             for r in range(args.batch_size)
@@ -147,7 +179,9 @@ def main():
     kind = "ring-flash" if args.ring_flash else "ring"
     per_dev = args.seq_len // groups[0].data_size
     tp = (
-        f" x {args.model_parallel}-way tensor/head parallel"
+        f" x {args.model_parallel}-way "
+        + ("expert" if args.moe else "tensor/head")
+        + " parallel"
         if args.model_parallel > 1
         else ""
     )
