@@ -106,10 +106,15 @@ def main():
     # knob generalized, SURVEY.md Q7)
     lrs = [1e-3 * (3.0**g) for g in range(args.ngroups)]
 
-    # Periodic corpus with a per-trial phase: perfectly learnable, so
-    # final perplexity ~1 is the correctness signal.
-    period = 16
-    base = np.tile(np.arange(period), args.seq_len // period + 1)
+    # Shared periodic corpus (data/datasets.py synthetic_corpus):
+    # perfectly learnable, so final perplexity ~1 is the correctness
+    # signal. Each trial samples its own fixed windows (seeded by
+    # group id), so trials see distinct data.
+    from multidisttorch_tpu.data import synthetic_corpus
+
+    corpus = synthetic_corpus(
+        n=max(65536, 4 * args.seq_len), vocab_size=args.vocab
+    )
 
     trials = []
     for g, lr in zip(groups, lrs):
@@ -145,10 +150,9 @@ def main():
                 if args.moe
                 else transformer_tp_shardings(g, model)
             )
-        rows = [
-            (base[: args.seq_len] + g.group_id + 2 * r) % args.vocab
-            for r in range(args.batch_size)
-        ]
+        rows = corpus.batch(
+            np.random.default_rng(g.group_id), args.batch_size, args.seq_len
+        )
         state = create_lm_state(
             g, model, tx, jax.random.key(g.group_id),
             example_len=args.seq_len, param_shardings=psh,
@@ -170,7 +174,7 @@ def main():
                 # spanning submesh each owner feeds only its
                 # addressable shards
                 "tokens": g.device_put(
-                    np.stack(rows).astype(np.int32),
+                    rows,
                     g.sharding(None, DATA_AXIS),
                 ),
             }

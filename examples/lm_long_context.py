@@ -22,7 +22,6 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
 import optax  # noqa: E402
 
 import multidisttorch_tpu as mdt  # noqa: E402
@@ -62,6 +61,12 @@ def main():
         "the Pallas flash kernel inside every hop (scores only ever in "
         "VMEM) — the framework's full long-context configuration",
     )
+    parser.add_argument(
+        "--corpus", type=str, default=None, metavar="FILE",
+        help="byte-level model a real local file (vocab 256, fresh "
+        "random windows each step) instead of the synthetic periodic "
+        "stream — zero-egress real data",
+    )
     args = parser.parse_args()
     if args.flash and args.ring_flash:
         parser.error("--flash and --ring-flash are mutually exclusive")
@@ -93,6 +98,22 @@ def main():
             f"({args.seq_len // g.size} per device)"
         )
 
+    if args.corpus:
+        from multidisttorch_tpu.data import byte_corpus
+
+        corpus = byte_corpus(args.corpus)
+        args.vocab = corpus.vocab_size
+        print(f"byte-modeling {corpus.name}: {len(corpus):,} tokens, "
+              f"vocab {corpus.vocab_size}")
+    else:
+        from multidisttorch_tpu.data import synthetic_corpus
+
+        # Periodic stream: perfectly learnable, so the loss trend is
+        # the whole story. Sized from the context so any --seq-len fits.
+        corpus = synthetic_corpus(
+            n=max(65536, 4 * args.seq_len), vocab_size=args.vocab, period=16
+        )
+
     model = TransformerLM(
         vocab_size=args.vocab,
         d_model=args.d_model,
@@ -107,27 +128,20 @@ def main():
     step = make_lm_train_step(g, model, tx,
                               sequence_parallel=not args.flash)
 
-    # Periodic corpus: perfectly learnable, so the loss trend is the
-    # whole story.
-    period = 16
     if args.flash and args.batch_size % g.size:
         # flash mode shards the BATCH over the group (plain DP; the
         # sequence stays whole per device) — round the batch up.
         args.batch_size = ((args.batch_size // g.size) + 1) * g.size
         print(f"flash mode: batch rounded up to {args.batch_size} "
               f"(divisible by {g.size} devices)")
-    base = np.tile(np.arange(period), args.seq_len // period + 1)
-    rows = [
-        (base[: args.seq_len] + 2 * r) % args.vocab
-        for r in range(args.batch_size)
-    ]
-    tokens = jax.device_put(
-        jnp.asarray(np.stack(rows).astype(np.int32)),
-        g.batch_sharding if args.flash else g.sharding(None, DATA_AXIS),
-    )
+    sharding = g.batch_sharding if args.flash else g.sharding(None, DATA_AXIS)
+    rng = np.random.default_rng(0)
 
     t0 = time.time()
     for i in range(args.steps):
+        tokens = g.device_put(
+            corpus.batch(rng, args.batch_size, args.seq_len), sharding
+        )
         state, m = step(state, tokens)
         if i % 10 == 0 or i == args.steps - 1:
             print(f"step {i:4d}  next-token loss {float(m['loss']):.4f}")

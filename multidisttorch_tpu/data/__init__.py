@@ -1,8 +1,11 @@
 from multidisttorch_tpu.data.datasets import (
     Dataset,
+    TokenCorpus,
+    byte_corpus,
     load_cifar10,
     load_mnist,
     synthetic_cifar10,
+    synthetic_corpus,
     synthetic_mnist,
 )
 from multidisttorch_tpu.data.sampler import EvalDataIterator, TrialDataIterator

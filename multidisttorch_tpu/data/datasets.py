@@ -213,3 +213,69 @@ def load_cifar10(
     n = synthetic_size if synthetic_size is not None else (50000 if train else 10000)
     warnings.warn("Using synthetic CIFAR-10 stand-in (no local data, no egress)")
     return synthetic_cifar10(n, seed=0 if train else 1)
+
+
+@dataclass(frozen=True)
+class TokenCorpus:
+    """Host-resident token stream for LM training.
+
+    The reference trains only on MNIST (vae-hpo.py:133-144); the LM
+    family needs token data, and with zero egress the honest sources
+    are (a) any local file read as bytes (vocab 256 — byte-level
+    modeling of real data) or (b) a synthetic periodic stream. Batches
+    are windows: ``batch(rng, b, t)`` samples ``b`` random ``t``-token
+    windows — the standard LM packing, every epoch a fresh slice mix.
+    """
+
+    tokens: np.ndarray  # (N,) int32
+    vocab_size: int
+    name: str
+    synthetic: bool = False
+
+    def __len__(self) -> int:
+        return self.tokens.shape[0]
+
+    def batch(self, rng: np.random.Generator, b: int, t: int) -> np.ndarray:
+        n = self.tokens.shape[0]
+        if n < t:
+            raise ValueError(
+                f"corpus of {n} tokens cannot fill windows of {t}"
+            )
+        # inclusive upper start: the final token must be reachable
+        starts = rng.integers(0, n - t + 1, size=b)
+        return np.stack(
+            [self.tokens[s : s + t] for s in starts]
+        ).astype(np.int32)
+
+
+def byte_corpus(path: str, *, name: str | None = None) -> TokenCorpus:
+    """Byte-level tokens from any local file (vocab 256, no egress)."""
+    with open(path, "rb") as f:
+        raw = np.frombuffer(f.read(), dtype=np.uint8)
+    return TokenCorpus(
+        tokens=raw.astype(np.int32),
+        vocab_size=256,
+        name=name or os.path.basename(path),
+    )
+
+
+def synthetic_corpus(
+    n: int = 65536, *, vocab_size: int = 32, period: int = 16, seed: int = 0
+) -> TokenCorpus:
+    """Perfectly learnable periodic stream — the LM examples' corpus.
+
+    Block ``i`` is ``(arange(period) + seed + i*stride) % vocab_size``
+    with a fixed stride, so every token (including each block's first)
+    is a deterministic function of its predecessors: the achievable
+    loss floor is exactly zero, which is what makes "loss falls toward
+    0 / perplexity toward 1" a correctness signal and not an artifact.
+    """
+    stride = 5  # coprime with common periods; any fixed value works
+    blocks = n // period + 2
+    phases = (seed + stride * np.arange(blocks)) % vocab_size
+    rows = [(np.arange(period) + p) % vocab_size for p in phases]
+    tokens = np.concatenate(rows)[:n].astype(np.int32)
+    return TokenCorpus(
+        tokens=tokens, vocab_size=vocab_size, name="synthetic-periodic",
+        synthetic=True,
+    )

@@ -258,3 +258,35 @@ def test_chunk_size_validated_eagerly():
         it.epoch_chunks(0, 0)
     with pytest.raises(ValueError, match="chunk size"):
         it.stream_chunks(-1)
+
+
+def test_token_corpus_windows_in_bounds(tmp_path):
+    from multidisttorch_tpu.data import byte_corpus, synthetic_corpus
+
+    p = tmp_path / "blob.bin"
+    p.write_bytes(bytes(range(256)) * 8)
+    c = byte_corpus(str(p))
+    assert len(c) == 2048 and c.vocab_size == 256 and not c.synthetic
+
+    rng = np.random.default_rng(0)
+    b = c.batch(rng, 16, 64)
+    assert b.shape == (16, 64) and b.dtype == np.int32
+    assert b.min() >= 0 and b.max() < 256
+    # windows really are contiguous slices of the stream
+    row = b[3]
+    assert ((row[1:] - row[:-1]) % 256 == 1).all()  # file is 0..255 cycle
+
+    s = synthetic_corpus(n=1024, vocab_size=32, period=16, seed=1)
+    assert s.synthetic and s.vocab_size == 32
+    sb = s.batch(rng, 4, 32)
+    assert sb.shape == (4, 32) and sb.max() < 32
+
+
+def test_token_corpus_too_short_raises(tmp_path):
+    from multidisttorch_tpu.data import byte_corpus
+
+    p = tmp_path / "tiny.bin"
+    p.write_bytes(b"abc")
+    c = byte_corpus(str(p))
+    with pytest.raises(ValueError, match="cannot fill"):
+        c.batch(np.random.default_rng(0), 1, 8)
