@@ -162,3 +162,71 @@ def create_lm_state(
             step=jnp.zeros((), jnp.int32),
         )
     )
+
+
+def make_lm_sample(
+    trial: TrialMesh,
+    model: Any,
+    *,
+    temperature: float = 0.0,
+    shardings: Any = None,
+) -> Callable[[TrainState, jax.Array, int, jax.Array], jax.Array]:
+    """Autoregressive sampling — the LM analog of the reference's
+    prior-sample dump (vae-hpo.py:163-170: draw from the model, look at
+    what it learned).
+
+    ``sample(state, tokens, prompt_len, rng) -> (B, T) int32``: the
+    ``(B, T)`` buffer holds the prompt in its first ``prompt_len``
+    positions (the rest is ignored); positions ``prompt_len..T-1`` are
+    filled autoregressively. Greedy at ``temperature=0``, else
+    softmax-temperature sampling. Shapes stay static (one ``(B, T)``
+    buffer; ``lax.fori_loop`` + ``dynamic_update_slice``) so one
+    compilation serves every prompt length; each step recomputes the
+    full prefix — O(T^2) attention per token, the simple exact
+    formulation (a KV cache is a bandwidth optimization, not a
+    semantics change). Causal attention guarantees the padding beyond
+    the current position cannot influence the next token.
+
+    ``prompt_len`` is clamped to >= 1: position 0 is always taken from
+    the buffer (a BOS/seed token) — "unconditional" sampling is
+    sampling conditioned on a chosen first token, never on buffer
+    garbage. The buffer batch-shards over the trial's data axis like
+    every other LM step (B must divide it).
+    """
+    repl = trial.replicated_sharding
+
+    def sample_fn(
+        state: TrainState, tokens: jax.Array, prompt_len, rng: jax.Array
+    ):
+        def body(i, carry):
+            buf, rng = carry
+            out = model.apply({"params": state.params}, buf)
+            logits = (out[0] if isinstance(out, tuple) else out)[:, i - 1]
+            if temperature > 0:
+                rng, sub = jax.random.split(rng)
+                nxt = jax.random.categorical(
+                    sub, logits / temperature, axis=-1
+                )
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, nxt[:, None].astype(buf.dtype), i, axis=1
+            )
+            return buf, rng
+
+        start = jnp.maximum(prompt_len, 1)  # never index position -1
+        buf, _ = jax.lax.fori_loop(
+            start, tokens.shape[1], body, (tokens, rng)
+        )
+        return buf
+
+    return jax.jit(
+        sample_fn,
+        in_shardings=(
+            repl if shardings is None else shardings,
+            trial.batch_sharding,
+            None,
+            repl,
+        ),
+        out_shardings=trial.batch_sharding,
+    )

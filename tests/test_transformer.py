@@ -405,3 +405,56 @@ def test_tp_auto_follows_ring_head_sharding():
                                                    **cfg))
     assert MODEL_AXIS not in tuple(sh["block_0"]["q"]["kernel"].spec)
     assert MODEL_AXIS in tuple(sh["block_0"]["up"]["kernel"].spec)
+
+
+def test_lm_sampling_reproduces_learned_pattern():
+    # Train on the deterministic periodic corpus, then greedy-decode
+    # from a short prompt: the model must continue the pattern exactly
+    # — the LM analog of the reference's prior-sample check.
+    from multidisttorch_tpu.data import synthetic_corpus
+    from multidisttorch_tpu.train.lm import make_lm_sample
+
+    (g,) = setup_groups(1)
+    corpus = synthetic_corpus(n=4096, vocab_size=16, period=16)
+    model = TransformerLM(
+        vocab_size=16, d_model=32, num_heads=2, num_layers=2, max_len=32
+    )
+    tx = optax.adam(5e-3)
+    state = create_lm_state(g, model, tx, jax.random.key(0), example_len=32)
+    step = make_lm_train_step(g, model, tx)
+    rng = np.random.default_rng(0)
+    for i in range(400):
+        toks = jax.device_put(
+            jnp.asarray(corpus.batch(rng, 8, 32)), g.batch_sharding
+        )
+        state, m = step(state, toks)
+    # Loss floor is not zero for randomly-aligned windows: the first
+    # block boundary's position is unknowable from a short prefix. The
+    # continuation from a 20-token prompt IS deterministic (some
+    # boundary has always been revealed by then), which is what the
+    # decode assertions below check exactly.
+    assert float(m["loss"]) < 0.3, float(m["loss"])
+
+    sample = make_lm_sample(g, model)  # greedy
+    window = corpus.batch(np.random.default_rng(99), 1, 32)
+    prompt_len = 20
+    buf = np.tile(window, (8, 1))  # B=8 identical prompts
+    # positions >= prompt_len are GARBAGE: the decode must ignore them
+    # (causality contract) and still reproduce the true continuation
+    buf[:, prompt_len:] = np.random.default_rng(5).integers(
+        0, 16, size=buf[:, prompt_len:].shape
+    )
+    buf = jnp.asarray(buf)
+    out = np.asarray(sample(state, buf, prompt_len, jax.random.key(1)))
+    # prompt preserved, continuation matches the true stream
+    np.testing.assert_array_equal(
+        out[:, :prompt_len], np.tile(window[:, :prompt_len], (8, 1))
+    )
+    np.testing.assert_array_equal(out, np.tile(window, (8, 1)))
+    # temperature sampling runs and stays in-vocab
+    hot = make_lm_sample(g, model, temperature=1.0)
+    out_t = np.asarray(hot(state, buf, prompt_len, jax.random.key(2)))
+    assert out_t.min() >= 0 and out_t.max() < 16
+    # prompt_len=0 clamps to 1: position 0 is the seed, never garbage
+    out0 = np.asarray(sample(state, buf, 0, jax.random.key(3)))
+    np.testing.assert_array_equal(out0[:, 0], np.asarray(buf)[:, 0])
