@@ -27,6 +27,11 @@ from multidisttorch_tpu.parallel.mesh import DATA_AXIS, TrialMesh
 from multidisttorch_tpu.train.steps import TrainState
 
 
+def _logits(out):
+    """Model outputs are logits, or (logits, aux) from the MoE LM."""
+    return out[0] if isinstance(out, tuple) else out
+
+
 def lm_loss_mean(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     """Mean next-token cross-entropy; the last position is masked (its
     target would wrap around the roll)."""
@@ -77,10 +82,10 @@ def make_lm_train_step(
     def step_fn(state: TrainState, tokens: jax.Array):
         def loss_fn(params):
             out = model.apply({"params": params}, tokens)
+            loss = lm_loss_mean(_logits(out), tokens)
             if isinstance(out, tuple):
-                logits, aux = out
-                return lm_loss_mean(logits, tokens) + aux_loss_weight * aux
-            return lm_loss_mean(out, tokens)
+                loss = loss + aux_loss_weight * out[1]
+            return loss
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
@@ -116,8 +121,7 @@ def make_lm_eval_step(
 
     def eval_fn(state: TrainState, tokens: jax.Array):
         out = model.apply({"params": state.params}, tokens)
-        logits = out[0] if isinstance(out, tuple) else out
-        loss = lm_loss_mean(logits, tokens)
+        loss = lm_loss_mean(_logits(out), tokens)
         return {
             "loss": loss.astype(jnp.float32),
             "perplexity": jnp.exp(loss).astype(jnp.float32),
@@ -201,7 +205,7 @@ def make_lm_sample(
         def body(i, carry):
             buf, rng = carry
             out = model.apply({"params": state.params}, buf)
-            logits = (out[0] if isinstance(out, tuple) else out)[:, i - 1]
+            logits = _logits(out)[:, i - 1]
             if temperature > 0:
                 rng, sub = jax.random.split(rng)
                 nxt = jax.random.categorical(
