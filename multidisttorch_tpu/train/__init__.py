@@ -5,6 +5,7 @@ from multidisttorch_tpu.train.lm import (
     make_lm_sample,
     make_lm_train_step,
 )
+from multidisttorch_tpu.train.lm_decode import make_cached_lm_sample
 from multidisttorch_tpu.train.lm_pipeline import make_pipelined_lm
 from multidisttorch_tpu.train.steps import (
     TrainState,

@@ -1,0 +1,171 @@
+"""KV-cache autoregressive decoding for the TransformerLM.
+
+``train.lm.make_lm_sample`` is the exact-but-simple sampler: every new
+token recomputes the whole prefix (O(T²) attention per token). Decode
+on TPU is bandwidth-bound, and the real serving formulation caches
+each block's K/V so one step touches O(T·D) cache plus O(D²) weights —
+this module is that formulation, TPU-first: one static
+``(L, 2, B, T, H, Dh)`` cache buffer carried through ``lax.fori_loop``
+(in-place ``dynamic_update_slice`` writes — no per-step rebuild),
+masked attention over the cache, a prefill loop for the prompt and a
+generation loop that samples — so the rng stream matches
+``make_lm_sample`` draw for draw.
+
+The per-position math intentionally re-implements ``models.transformer
+.Block``'s forward (a flax module can't thread an explicit cache
+through an injected ``attention`` callable without changing its
+signature); the decode-vs-model parity tests in
+``tests/test_lm_decode.py`` pin the two together — if the Block
+changes, those tests fail before any silent drift ships. Scope:
+dense-block float32 ``TransformerLM`` only (MoE routing per decoded
+token is a different schedule, and bf16 compute would need flax's
+exact cast placement — both fall back to ``make_lm_sample``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from multidisttorch_tpu.parallel.mesh import TrialMesh
+from multidisttorch_tpu.train.steps import TrainState
+
+_LN_EPS = 1e-6  # flax nn.LayerNorm default, which the model uses
+
+
+def _layernorm(p, x):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + _LN_EPS) * p["scale"] + p["bias"]
+
+
+def _dense(p, x):
+    return x @ p["kernel"] + p["bias"]
+
+
+def make_cached_lm_sample(
+    trial: TrialMesh,
+    model: Any,
+    *,
+    temperature: float = 0.0,
+    shardings: Any = None,
+) -> Callable[[TrainState, jax.Array, int, jax.Array], jax.Array]:
+    """KV-cached ``sample(state, tokens, prompt_len, rng) -> (B, T)``.
+
+    Same contract as :func:`train.lm.make_lm_sample` (prompt in the
+    buffer's first ``prompt_len`` positions, clamped >= 1; the rest is
+    filled autoregressively; greedy at ``temperature=0``; buffer
+    batch-sharded; ``shardings`` for weight-sharded states), but each
+    position costs one cache-masked attention instead of a full-prefix
+    forward.
+    """
+    if model.dtype != jnp.float32:
+        raise ValueError(
+            "make_cached_lm_sample implements float32 compute; for a "
+            f"{model.dtype} model use make_lm_sample (flax's exact "
+            "cast placement is the model's business)"
+        )
+    num_heads = model.num_heads
+    num_layers = model.num_layers
+    max_len = model.max_len
+
+    def process_position(p, buf, caches, i):
+        """Run position ``i`` through the stack, writing its K/V into
+        every layer's cache; returns (caches, logits_at_i)."""
+        b, t = buf.shape
+        tok = jax.lax.dynamic_index_in_dim(buf, i, axis=1)[:, 0]
+        x = (
+            p["tok_embed"]["embedding"][tok]
+            + p["pos_embed"]["embedding"][i]
+        )
+        d = x.shape[-1]
+        dh = d // num_heads
+        for layer in range(num_layers):
+            bp = p[f"block_{layer}"]
+            y = _layernorm(bp["ln_attn"], x)
+            q = _dense(bp["q"], y).reshape(b, num_heads, dh)
+            k = _dense(bp["k"], y).reshape(b, num_heads, dh)
+            v = _dense(bp["v"], y).reshape(b, num_heads, dh)
+            # in-place writes into the carried 6-D cache
+            caches = jax.lax.dynamic_update_slice(
+                caches, k[None, None, :, None], (layer, 0, 0, i, 0, 0)
+            )
+            caches = jax.lax.dynamic_update_slice(
+                caches, v[None, None, :, None], (layer, 1, 0, i, 0, 0)
+            )
+            k_cache = caches[layer, 0]  # (B, T, H, Dh)
+            v_cache = caches[layer, 1]
+            s = jnp.einsum("bhd,bthd->bht", q, k_cache) / jnp.sqrt(
+                jnp.float32(dh)
+            )
+            mask = (jnp.arange(t) <= i)[None, None, :]
+            s = jnp.where(mask, s, -jnp.inf)
+            w = jax.nn.softmax(s, axis=-1)
+            attn = jnp.einsum("bht,bthd->bhd", w, v_cache).reshape(b, d)
+            x = x + _dense(bp["proj"], attn)
+
+            y = _layernorm(bp["ln_mlp"], x)
+            y = _dense(bp["up"], y)
+            y = jax.nn.gelu(y)
+            x = x + _dense(bp["down"], y)
+        x = _layernorm(p["ln_out"], x)
+        return caches, _dense(p["head"], x)  # (B, vocab)
+
+    def sample_fn(
+        state: TrainState, tokens: jax.Array, prompt_len, rng: jax.Array
+    ):
+        p = state.params
+        b, t = tokens.shape
+        if t > max_len:
+            # same trace-time contract as the model's own forward
+            raise ValueError(
+                f"sequence length {t} exceeds max_len={max_len}"
+            )
+        d = p["tok_embed"]["embedding"].shape[1]
+        caches = jnp.zeros(
+            (num_layers, 2, b, t, num_heads, d // num_heads), jnp.float32
+        )
+        start = jnp.maximum(prompt_len, 1)
+
+        # Prefill: positions 0..start-2 fill the caches; no sampling,
+        # no rng draws (matching make_lm_sample's stream exactly).
+        def prefill(i, caches):
+            caches, _ = process_position(p, tokens, caches, i)
+            return caches
+
+        caches = jax.lax.fori_loop(0, start - 1, prefill, caches)
+
+        # Generate: position i-1's logits choose the token at i.
+        def body(i, carry):
+            buf, caches, rng = carry
+            caches, logits = process_position(p, buf, caches, i - 1)
+            if temperature > 0:
+                rng, sub = jax.random.split(rng)
+                nxt = jax.random.categorical(
+                    sub, logits / temperature, axis=-1
+                )
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, nxt[:, None].astype(buf.dtype), i, axis=1
+            )
+            return buf, caches, rng
+
+        buf, _, _ = jax.lax.fori_loop(
+            start, t, body, (tokens, caches, rng)
+        )
+        return buf
+
+    repl = trial.replicated_sharding
+    return jax.jit(
+        sample_fn,
+        in_shardings=(
+            repl if shardings is None else shardings,
+            trial.batch_sharding,
+            None,
+            repl,
+        ),
+        out_shardings=trial.batch_sharding,
+    )
