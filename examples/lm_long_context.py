@@ -152,9 +152,11 @@ def main():
     # trials by sampling the model (vae-hpo.py:163-170); this is the LM
     # analog. Decoding needs the whole sequence per device, so it uses
     # the batch-sharded contract (prompt replicated to a full batch).
-    from multidisttorch_tpu.train.lm import make_lm_sample
+    # KV-cache decode (one cache-masked attention per token) — parity-
+    # pinned to the full-recompute sampler in tests/test_lm_decode.py.
+    from multidisttorch_tpu.train.lm_decode import make_cached_lm_sample
 
-    sample = make_lm_sample(g, model, temperature=0.0)
+    sample = make_cached_lm_sample(g, model, temperature=0.0)
     prompt_len = args.seq_len // 2
     window = corpus.batch(np.random.default_rng(1), 1, args.seq_len)
     # rows are identical prompts; g.size rows satisfy batch sharding

@@ -67,6 +67,17 @@ def make_cached_lm_sample(
             f"{model.dtype} model use make_lm_sample (flax's exact "
             "cast placement is the model's business)"
         )
+    if getattr(model, "num_experts", None) is not None:
+        raise ValueError(
+            "make_cached_lm_sample supports dense-block TransformerLM "
+            "only; MoE routing per decoded token is a different "
+            "schedule — use make_lm_sample"
+        )
+    # The decode path always computes exact dense causal attention.
+    # The model's injected `attention` (ring / ring-flash / flash) is
+    # assumed to be exactly that, computed a different way — true for
+    # every callable this repo ships; a future non-equivalent attention
+    # (sliding window, local masking) must not use this sampler.
     num_heads = model.num_heads
     num_layers = model.num_layers
     max_len = model.max_len
@@ -124,18 +135,36 @@ def make_cached_lm_sample(
                 f"sequence length {t} exceeds max_len={max_len}"
             )
         d = p["tok_embed"]["embedding"].shape[1]
-        caches = jnp.zeros(
-            (num_layers, 2, b, t, num_heads, d // num_heads), jnp.float32
-        )
+        dh = d // num_heads
         start = jnp.maximum(prompt_len, 1)
 
-        # Prefill: positions 0..start-2 fill the caches; no sampling,
-        # no rng draws (matching make_lm_sample's stream exactly).
-        def prefill(i, caches):
-            caches, _ = process_position(p, tokens, caches, i)
-            return caches
+        # Prefill: ONE batched causal forward over the whole buffer
+        # fills every layer's K/V slab (static shapes; no rng draws, so
+        # the sampling stream still matches make_lm_sample exactly).
+        # Cache slots >= start-1 are garbage-derived here, but the
+        # generation loop rewrites slot i-1 before any read of it, so
+        # only the prompt region's entries are ever consumed as-is.
+        from multidisttorch_tpu.ops.ring_attention import (
+            dense_attention_reference,
+        )
 
-        caches = jax.lax.fori_loop(0, start - 1, prefill, caches)
+        x = (
+            p["tok_embed"]["embedding"][tokens]
+            + p["pos_embed"]["embedding"][jnp.arange(t)][None]
+        )  # (B, T, d)
+        slabs = []
+        for layer in range(num_layers):
+            bp = p[f"block_{layer}"]
+            y = _layernorm(bp["ln_attn"], x)
+            q = _dense(bp["q"], y).reshape(b, t, num_heads, dh)
+            k = _dense(bp["k"], y).reshape(b, t, num_heads, dh)
+            v = _dense(bp["v"], y).reshape(b, t, num_heads, dh)
+            slabs.append(jnp.stack([k, v]))
+            attn = dense_attention_reference(q, k, v, causal=True)
+            x = x + _dense(bp["proj"], attn.reshape(b, t, d))
+            y = _layernorm(bp["ln_mlp"], x)
+            x = x + _dense(bp["down"], jax.nn.gelu(_dense(bp["up"], y)))
+        caches = jnp.stack(slabs)  # (L, 2, B, T, H, Dh)
 
         # Generate: position i-1's logits choose the token at i.
         def body(i, carry):

@@ -91,3 +91,15 @@ def test_cached_decode_rejects_overlong_buffer():
     long_buf = jnp.zeros((8, 32), jnp.int32)
     with pytest.raises(ValueError, match="exceeds max_len"):
         cached(state, long_buf, 4, jax.random.key(0))
+
+
+def test_cached_decode_rejects_moe_models():
+    from multidisttorch_tpu.models.transformer import MoETransformerLM
+
+    (g,) = setup_groups(1)
+    moe = MoETransformerLM(
+        vocab_size=32, d_model=32, num_heads=4, num_layers=1,
+        num_experts=2, max_len=16,
+    )
+    with pytest.raises(ValueError, match="dense-block"):
+        make_cached_lm_sample(g, moe)
