@@ -567,6 +567,75 @@ def bench_lm() -> dict:
     }
 
 
+def bench_decode() -> dict:
+    """KV-cached generation throughput (the serving-side metric).
+
+    The sampler runs prefill + generation in one jitted program, so a
+    raw end-to-end timing would mix the compute-bound prefill into the
+    bandwidth-bound decode number. Two timed configurations isolate
+    it: a full pass (prompt T/2) and a prefill-dominated pass (prompt
+    T-1, one generated token); the difference in time over the
+    difference in generated tokens is the per-token decode rate —
+    which tracks HBM bandwidth (each token touches the whole cache +
+    weights once), not MXU peak.
+    """
+    from multidisttorch_tpu.models.transformer import TransformerLM
+    from multidisttorch_tpu.parallel.mesh import setup_groups
+    from multidisttorch_tpu.train.lm import create_lm_state
+    from multidisttorch_tpu.train.lm_decode import make_cached_lm_sample
+
+    (trial,) = setup_groups(1)
+    model = TransformerLM(
+        vocab_size=LM_VOCAB, d_model=LM_DMODEL, num_heads=LM_HEADS,
+        num_layers=LM_LAYERS, max_len=LM_SEQ,
+    )
+    state = create_lm_state(
+        trial, model, optax.adam(1e-3), jax.random.key(0),
+        example_len=LM_SEQ,
+    )
+    fn = make_cached_lm_sample(trial, model)
+    prompt_len = LM_SEQ // 2
+    buf = jax.device_put(
+        jnp.asarray(
+            np.random.default_rng(0).integers(
+                0, LM_VOCAB, (LM_BATCH, LM_SEQ), dtype=np.int32
+            )
+        ),
+        trial.batch_sharding,
+    )
+    out = fn(state, buf, prompt_len, jax.random.key(1))  # compile
+    jax.block_until_ready(out)
+
+    def timed(plen: int) -> float:
+        t0 = time.perf_counter()
+        out = fn(state, buf, plen, jax.random.key(2))
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    gen_full = LM_BATCH * (LM_SEQ - prompt_len)
+    gen_pre = LM_BATCH * 1  # prompt T-1: prefill + one generated token
+    rates = []
+    for _ in range(MEASURE_REPEATS):
+        dt = timed(prompt_len) - timed(LM_SEQ - 1)
+        if dt > 0:
+            rates.append((gen_full - gen_pre) / dt)
+    ndev = len(jax.devices())
+    if not rates:  # prefill noise swamped the decode delta
+        return {"error": "decode delta not measurable (timing noise)"}
+    return {
+        "decode_tokens_per_sec_per_chip": round(
+            float(np.median(rates)) / ndev, 1
+        ),
+        "pass_rates": [round(x, 1) for x in rates],
+        "generated_per_pass": gen_full,
+        "prompt_len": prompt_len,
+        "config": {
+            "vocab": LM_VOCAB, "d_model": LM_DMODEL, "heads": LM_HEADS,
+            "layers": LM_LAYERS, "seq_len": LM_SEQ, "batch": LM_BATCH,
+        },
+    }
+
+
 def bench_suite() -> dict:
     """Every measurement in ONE process, for one-shot chip windows.
 
@@ -588,6 +657,8 @@ def bench_suite() -> dict:
         # suite must always finish inside the driver's budget.
         ("lm", bench_lm if on_tpu
          else (lambda: {"skipped": "full-size LM needs the TPU"})),
+        ("decode", bench_decode if on_tpu
+         else (lambda: {"skipped": "full-size decode needs the TPU"})),
         ("to_elbo_150", lambda: bench_to_elbo(150.0)),
         ("loader", bench_loader),
     ):
@@ -915,6 +986,11 @@ def main():
         "(the MXU-bound headline the tiny VAE cannot provide)",
     )
     parser.add_argument(
+        "--decode", action="store_true",
+        help="measure KV-cached generation throughput "
+        "(tokens/sec/chip — the bandwidth-bound serving metric)",
+    )
+    parser.add_argument(
         "--suite", action="store_true",
         help="bank every measurement (flagship, fused-loss comparison, "
         "LM, to-elbo, loader) in one process — for one-shot windows on "
@@ -924,9 +1000,9 @@ def main():
 
     if sum(x is not None and x is not False
            for x in (args.concurrency, args.to_elbo, args.loader,
-                     args.lm, args.suite)) > 1:
-        parser.error("--concurrency/--to-elbo/--loader/--lm/--suite are "
-                     "mutually exclusive")
+                     args.lm, args.suite, args.decode)) > 1:
+        parser.error("--concurrency/--to-elbo/--loader/--lm/--decode/"
+                     "--suite are mutually exclusive")
 
     # Every mode goes through the preflight first: the train_loop loader
     # condition (and all training modes) touch jax.devices(), which on a
@@ -961,6 +1037,22 @@ def main():
                     "unit": "tokens/sec/chip",
                     "vs_baseline": None,
                     "mfu": r["mfu"],
+                    "detail": r,
+                }
+            )
+        )
+        return
+
+    if args.decode:
+        r = bench_decode()
+        r.update(backend)
+        print(
+            json.dumps(
+                {
+                    "metric": "lm_decode_tokens_per_sec_per_chip",
+                    "value": r["decode_tokens_per_sec_per_chip"],
+                    "unit": "tokens/sec/chip",
+                    "vs_baseline": None,
                     "detail": r,
                 }
             )

@@ -144,9 +144,23 @@ def make_cached_lm_sample(
         # Cache slots >= start-1 are garbage-derived here, but the
         # generation loop rewrites slot i-1 before any read of it, so
         # only the prompt region's entries are ever consumed as-is.
-        from multidisttorch_tpu.ops.ring_attention import (
-            dense_attention_reference,
-        )
+        # Deliberately full-T (not prompt-only): prompt_len stays
+        # traced, so one compilation serves every prompt length and the
+        # ring paths' T-divisibility holds; a caller with short static
+        # prompts can simply pass a shorter buffer. The attention is
+        # the MODEL'S OWN callable (flash/ring keep memory linear on
+        # long contexts; only the no-injection default uses the O(T²)
+        # dense reference).
+        if model.attention is not None:
+            prefill_attn = model.attention
+        else:
+            from multidisttorch_tpu.ops.ring_attention import (
+                dense_attention_reference,
+            )
+
+            prefill_attn = lambda q, k, v: dense_attention_reference(
+                q, k, v, causal=True
+            )
 
         x = (
             p["tok_embed"]["embedding"][tokens]
@@ -160,7 +174,7 @@ def make_cached_lm_sample(
             k = _dense(bp["k"], y).reshape(b, t, num_heads, dh)
             v = _dense(bp["v"], y).reshape(b, t, num_heads, dh)
             slabs.append(jnp.stack([k, v]))
-            attn = dense_attention_reference(q, k, v, causal=True)
+            attn = prefill_attn(q, k, v)
             x = x + _dense(bp["proj"], attn.reshape(b, t, d))
             y = _layernorm(bp["ln_mlp"], x)
             x = x + _dense(bp["down"], jax.nn.gelu(_dense(bp["up"], y)))

@@ -79,6 +79,20 @@ def test_bench_lm_smoke(monkeypatch):
     assert np.isfinite(r["final_loss"])
 
 
+def test_bench_decode_smoke(monkeypatch):
+    monkeypatch.setattr(bench, "LM_VOCAB", 64)
+    monkeypatch.setattr(bench, "LM_DMODEL", 32)
+    monkeypatch.setattr(bench, "LM_HEADS", 2)
+    monkeypatch.setattr(bench, "LM_LAYERS", 1)
+    monkeypatch.setattr(bench, "LM_SEQ", 32)
+    monkeypatch.setattr(bench, "LM_BATCH", 8)
+    monkeypatch.setattr(bench, "MEASURE_REPEATS", 1)
+    r = bench.bench_decode()
+    assert r["decode_tokens_per_sec_per_chip"] > 0
+    assert r["generated_per_pass"] == 8 * 16
+    assert r["prompt_len"] == 16
+
+
 def test_bench_ours_smoke(monkeypatch):
     monkeypatch.setattr(bench, "CHUNK_STEPS", 3)
     monkeypatch.setattr(bench, "MEASURE_CHUNKS", 2)

@@ -103,3 +103,30 @@ def test_cached_decode_rejects_moe_models():
     )
     with pytest.raises(ValueError, match="dense-block"):
         make_cached_lm_sample(g, moe)
+
+
+def test_cached_decode_with_ring_attention_model():
+    # A ring-attention model prefills through its own ring callable
+    # (linear memory on long contexts); greedy decode must still match
+    # the full-recompute sampler on the same model.
+    from multidisttorch_tpu.ops.ring_attention import make_ring_attention
+
+    (g,) = setup_groups(1)
+    t = 24  # divides the 8-device ring
+    model = TransformerLM(
+        vocab_size=32, d_model=32, num_heads=4, num_layers=2, max_len=t,
+        attention=make_ring_attention(g, causal=True),
+    )
+    state = create_lm_state(
+        g, model, optax.adam(1e-3), jax.random.key(0), example_len=t
+    )
+    buf = jnp.asarray(
+        np.random.default_rng(4).integers(0, 32, (8, t), dtype=np.int32)
+    )
+    out_cached = np.asarray(
+        make_cached_lm_sample(g, model)(state, buf, 6, jax.random.key(0))
+    )
+    out_full = np.asarray(
+        make_lm_sample(g, model)(state, buf, 6, jax.random.key(0))
+    )
+    np.testing.assert_array_equal(out_cached, out_full)
