@@ -1,0 +1,107 @@
+"""Example CLIs as subprocess smoke tests.
+
+The reference's de-facto test strategy is runnable examples
+(SURVEY.md §4); this repo's examples are its user-facing surface, so
+each one runs here at tiny sizes — exit code, key output lines, and
+the learning signal are asserted. Sizes are chosen to keep each run
+under ~1 minute on the 8-virtual-CPU-device world.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=300, tmp=None):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch a TPU plugin
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    argv = [sys.executable, os.path.join(_ROOT, "examples", args[0]), *args[1:]]
+    if tmp is not None:  # artifact-writing examples land in tmp_path
+        argv += ["--out-dir", str(tmp)]
+    p = subprocess.run(
+        argv, capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=_ROOT,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    return p.stdout
+
+
+@pytest.mark.examples
+def test_example_subgroup_parity():
+    out = _run(["example_subgroup.py"])
+    assert "subgroup 0 gathered: [0, 1, 2, 3]" in out
+    assert "subgroup 1 gathered: [4, 5, 6, 7]" in out
+
+
+@pytest.mark.examples
+def test_vae_hpo_example(tmp_path):
+    # --synthetic-size keeps it hermetic (no MNIST download attempt)
+    # and tiny; --out-dir keeps artifacts out of the repo tree
+    out = _run(["vae_hpo.py", "--epochs", "1", "--ngroups", "2",
+                "--batch-size", "128", "--synthetic-size", "2048"],
+               tmp=tmp_path)
+    assert "trial 0:" in out and "trial 1:" in out
+    assert "test loss" in out
+    assert (tmp_path / "trial-0" / "metrics.json").exists()
+
+
+@pytest.mark.examples
+def test_lm_hpo_example():
+    out = _run(["lm_hpo.py", "--ngroups", "2", "--seq-len", "64",
+                "--steps", "12"])
+    assert out.count("perplexity") == 2
+
+
+@pytest.mark.examples
+def test_lm_long_context_example():
+    out = _run(["lm_long_context.py", "--seq-len", "64", "--steps", "8"])
+    assert "greedy decode matches" in out
+
+
+@pytest.mark.examples
+def test_lm_long_context_byte_corpus():
+    out = _run(["lm_long_context.py", "--seq-len", "64", "--steps", "8",
+                "--corpus", os.path.join(_ROOT, "README.md")])
+    assert "byte-modeling README.md" in out
+    assert "decoded:" in out
+
+
+@pytest.mark.examples
+def test_pbt_example(tmp_path):
+    out = _run(["pbt_vae.py", "--population", "4", "--generations", "2",
+                "--steps-per-generation", "4", "--synthetic-size", "512"],
+               tmp=tmp_path)
+    assert "best" in out.lower()
+
+
+@pytest.mark.examples
+def test_resnet_hpo_example():
+    out = _run(["resnet_hpo.py", "--ngroups", "2", "--epochs", "1",
+                "--base-channels", "8", "--synthetic-size", "512",
+                "--batch-size", "64"])
+    assert out.count("test acc") == 2
+
+
+@pytest.mark.examples
+def test_beta_vae_cifar_example(tmp_path):
+    out = _run(["beta_vae_cifar.py", "--ngroups", "4", "--epochs", "1",
+                "--synthetic-size", "512", "--batch-size", "32"],
+               tmp=tmp_path)
+    assert "trial" in out
+
+
+@pytest.mark.examples
+def test_moe_vae_hpo_example(tmp_path):
+    out = _run(["moe_vae_hpo.py", "--ngroups", "2", "--model-parallel",
+                "2", "--epochs", "1", "--synthetic-size", "512"],
+               tmp=tmp_path)
+    assert "trial" in out
