@@ -7,6 +7,10 @@ from multidisttorch_tpu.train.lm import (
 )
 from multidisttorch_tpu.train.lm_decode import make_cached_lm_sample
 from multidisttorch_tpu.train.lm_pipeline import make_pipelined_lm
+from multidisttorch_tpu.train.lm_quant import (
+    dequantize_lm_params,
+    quantize_lm_params,
+)
 from multidisttorch_tpu.train.steps import (
     TrainState,
     create_train_state,

@@ -42,6 +42,8 @@ def _layernorm(p, x):
 
 
 def _dense(p, x):
+    if "q" in p:  # int8 weight-only quantized layer (train/lm_quant.py)
+        return (x @ p["q"].astype(jnp.float32)) * p["scale"] + p["bias"]
     return x @ p["kernel"] + p["bias"]
 
 

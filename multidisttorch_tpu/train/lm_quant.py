@@ -1,0 +1,77 @@
+"""Post-training int8 weight quantization for LM decoding.
+
+KV-cached decode (``train/lm_decode.py``) is bandwidth-bound: each
+generated token streams every weight matrix through the chip once. A
+per-output-channel symmetric int8 quantization cuts that traffic 4x
+against f32 — the classic serving trade — and the dequantize-scale
+fuses into the matmul under XLA, so the compute path barely changes.
+
+``quantize_lm_params`` rewrites every 2-D dense kernel in a
+TransformerLM param tree as ``{"q": int8, "scale": f32 (out,)}``
+(bias untouched; embeddings, norms, and everything 1-D stay f32 — the
+embedding is a gather, not a matmul, and norm params are tiny).
+``train.lm_decode._dense`` understands both forms, so the quantized
+tree drops straight into ``make_cached_lm_sample`` — with the sampler's
+DEFAULT replicated placement (the quantized tree's structure differs
+from the f32 one, so ``shardings=`` pytrees built from the f32 state
+do not apply; weight-sharded serving would need shardings built for
+the quantized structure). Accuracy is a measured property, not a
+promise: ``tests/test_lm_quant.py`` bounds the logit drift and checks
+greedy-decode agreement on a trained model.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+
+def _quantize_kernel(w: jnp.ndarray) -> dict:
+    """Symmetric per-output-channel int8: w ≈ q * scale."""
+    amax = jnp.max(jnp.abs(w), axis=0)  # (out,)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "scale": scale}
+
+
+def quantize_lm_params(params: Any) -> Any:
+    """Quantize every 2-D ``kernel`` leaf of an LM param tree to int8.
+
+    Returns a tree of the same structure where each dense layer's
+    ``{"kernel": (in, out) f32, "bias": ...}`` becomes
+    ``{"q": int8, "scale": f32, "bias": ...}``. Embeddings
+    (``embedding`` leaves), LayerNorm scales/biases, and biases are
+    untouched.
+    """
+
+    def rewrite(tree):
+        if isinstance(tree, dict):
+            if "kernel" in tree and getattr(tree["kernel"], "ndim", 0) == 2:
+                out = {k: v for k, v in tree.items() if k != "kernel"}
+                out.update(_quantize_kernel(tree["kernel"]))
+                return out
+            return {k: rewrite(v) for k, v in tree.items()}
+        return tree
+
+    # pure on-device transform: no host round-trip, placement preserved
+    # for the untouched leaves
+    return rewrite(params)
+
+
+def dequantize_lm_params(qparams: Any) -> Any:
+    """Reconstruct an f32 param tree (for comparison/inspection)."""
+
+    def rewrite(tree):
+        if isinstance(tree, dict):
+            if "q" in tree and "scale" in tree:
+                out = {k: v for k, v in tree.items()
+                       if k not in ("q", "scale")}
+                out["kernel"] = (
+                    tree["q"].astype(jnp.float32) * tree["scale"]
+                )
+                return out
+            return {k: rewrite(v) for k, v in tree.items()}
+        return tree
+
+    return rewrite(qparams)
