@@ -583,6 +583,7 @@ def bench_decode() -> dict:
     from multidisttorch_tpu.parallel.mesh import setup_groups
     from multidisttorch_tpu.train.lm import create_lm_state
     from multidisttorch_tpu.train.lm_decode import make_cached_lm_sample
+    from multidisttorch_tpu.train.lm_quant import quantize_lm_params
 
     (trial,) = setup_groups(1)
     model = TransformerLM(
@@ -603,30 +604,45 @@ def bench_decode() -> dict:
         ),
         trial.batch_sharding,
     )
-    out = fn(state, buf, prompt_len, jax.random.key(1))  # compile
-    jax.block_until_ready(out)
-
-    def timed(plen: int) -> float:
-        t0 = time.perf_counter()
-        out = fn(state, buf, plen, jax.random.key(2))
-        jax.block_until_ready(out)
-        return time.perf_counter() - t0
-
     gen_full = LM_BATCH * (LM_SEQ - prompt_len)
     gen_pre = LM_BATCH * 1  # prompt T-1: prefill + one generated token
-    rates = []
-    for _ in range(MEASURE_REPEATS):
-        dt = timed(prompt_len) - timed(LM_SEQ - 1)
-        if dt > 0:
-            rates.append((gen_full - gen_pre) / dt)
     ndev = len(jax.devices())
-    if not rates:  # prefill noise swamped the decode delta
+
+    def decode_rate(st) -> float | None:
+        out = fn(st, buf, prompt_len, jax.random.key(1))  # compile
+        jax.block_until_ready(out)
+
+        def timed(plen: int) -> float:
+            t0 = time.perf_counter()
+            o = fn(st, buf, plen, jax.random.key(2))
+            jax.block_until_ready(o)
+            return time.perf_counter() - t0
+
+        rates = []
+        for _ in range(MEASURE_REPEATS):
+            dt = timed(prompt_len) - timed(LM_SEQ - 1)
+            if dt > 0:
+                rates.append((gen_full - gen_pre) / dt)
+        return float(np.median(rates)) / ndev if rates else None
+
+    f32_rate = decode_rate(state)
+    int8_rate = decode_rate(
+        state.replace(params=quantize_lm_params(state.params))
+    )
+    measured = {
+        k: v for k, v in (("f32", f32_rate), ("int8", int8_rate))
+        if v is not None
+    }
+    if not measured:  # prefill noise swamped both decode deltas
         return {"error": "decode delta not measurable (timing noise)"}
+    winner = max(measured, key=measured.get)
     return {
-        "decode_tokens_per_sec_per_chip": round(
-            float(np.median(rates)) / ndev, 1
-        ),
-        "pass_rates": [round(x, 1) for x in rates],
+        "decode_tokens_per_sec_per_chip": round(measured[winner], 1),
+        "weights_winner": winner,
+        "variants": {
+            "f32": round(f32_rate, 1) if f32_rate is not None else None,
+            "int8": round(int8_rate, 1) if int8_rate is not None else None,
+        },
         "generated_per_pass": gen_full,
         "prompt_len": prompt_len,
         "config": {
