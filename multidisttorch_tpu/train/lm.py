@@ -32,6 +32,55 @@ def _logits(out):
     return out[0] if isinstance(out, tuple) else out
 
 
+def _filter_logits(logits, top_k, top_p):
+    """Top-k / nucleus filtering for sampling, shared by both samplers.
+
+    ``top_k``: keep the k highest logits per row. ``top_p``: keep the
+    smallest set of tokens whose probability mass reaches p (the
+    highest-probability token always survives). Both may combine.
+
+    RANK-based, not value-threshold: one stable descending argsort
+    (ties resolved in index order, so rank 0 is exactly ``argmax``),
+    masks computed in sorted space, scattered back to vocab positions
+    — exact counts even on tied or uniform logits, and one sort serves
+    both filters.
+    """
+    b, v = logits.shape
+    if top_k is not None and not 1 <= top_k <= v:
+        raise ValueError(f"top_k={top_k} must be in [1, vocab={v}]")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p={top_p} must be in (0, 1]")
+    idx = jnp.argsort(-logits, axis=-1)  # descending, argmax-stable
+    sorted_logits = jnp.take_along_axis(logits, idx, axis=-1)
+    keep = jnp.ones((b, v), bool)
+    if top_k is not None:
+        keep &= jnp.arange(v)[None, :] < top_k
+    if top_p is not None:
+        cum = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+        # smallest prefix with mass >= p; the top token always stays
+        keep &= jnp.concatenate(
+            [jnp.ones((b, 1), bool), cum[:, :-1] < top_p], axis=-1
+        )
+    keep_vocab = (
+        jnp.zeros((b, v), bool)
+        .at[jnp.arange(b)[:, None], idx]
+        .set(keep)
+    )
+    return jnp.where(keep_vocab, logits, jnp.float32(-jnp.inf))
+
+
+def _sample_token(logits, rng, temperature, top_k, top_p):
+    """One draw shared by both samplers: greedy at temperature 0, else
+    filtered softmax-temperature sampling. Returns (token, new_rng)."""
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1), rng
+    rng, sub = jax.random.split(rng)
+    filtered = _filter_logits(
+        logits.astype(jnp.float32) / temperature, top_k, top_p
+    )
+    return jax.random.categorical(sub, filtered, axis=-1), rng
+
+
 def lm_loss_mean(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     """Mean next-token cross-entropy; the last position is masked (its
     target would wrap around the roll)."""
@@ -173,6 +222,8 @@ def make_lm_sample(
     model: Any,
     *,
     temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
     shardings: Any = None,
 ) -> Callable[[TrainState, jax.Array, int, jax.Array], jax.Array]:
     """Autoregressive sampling — the LM analog of the reference's
@@ -205,14 +256,9 @@ def make_lm_sample(
         def body(i, carry):
             buf, rng = carry
             out = model.apply({"params": state.params}, buf)
-            logits = _logits(out)[:, i - 1]
-            if temperature > 0:
-                rng, sub = jax.random.split(rng)
-                nxt = jax.random.categorical(
-                    sub, logits / temperature, axis=-1
-                )
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
+            nxt, rng = _sample_token(
+                _logits(out)[:, i - 1], rng, temperature, top_k, top_p
+            )
             buf = jax.lax.dynamic_update_slice_in_dim(
                 buf, nxt[:, None].astype(buf.dtype), i, axis=1
             )

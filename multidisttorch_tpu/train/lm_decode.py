@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from multidisttorch_tpu.parallel.mesh import TrialMesh
+from multidisttorch_tpu.train.lm import _sample_token
 from multidisttorch_tpu.train.steps import TrainState
 
 _LN_EPS = 1e-6  # flax nn.LayerNorm default, which the model uses
@@ -52,6 +53,8 @@ def make_cached_lm_sample(
     model: Any,
     *,
     temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
     shardings: Any = None,
 ) -> Callable[[TrainState, jax.Array, int, jax.Array], jax.Array]:
     """KV-cached ``sample(state, tokens, prompt_len, rng) -> (B, T)``.
@@ -186,13 +189,9 @@ def make_cached_lm_sample(
         def body(i, carry):
             buf, caches, rng = carry
             caches, logits = process_position(p, buf, caches, i - 1)
-            if temperature > 0:
-                rng, sub = jax.random.split(rng)
-                nxt = jax.random.categorical(
-                    sub, logits / temperature, axis=-1
-                )
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
+            nxt, rng = _sample_token(
+                logits, rng, temperature, top_k, top_p
+            )
             buf = jax.lax.dynamic_update_slice_in_dim(
                 buf, nxt[:, None].astype(buf.dtype), i, axis=1
             )

@@ -130,3 +130,58 @@ def test_cached_decode_with_ring_attention_model():
         make_lm_sample(g, model)(state, buf, 6, jax.random.key(0))
     )
     np.testing.assert_array_equal(out_cached, out_full)
+
+
+def test_filter_logits_top_k_and_top_p():
+    from multidisttorch_tpu.train.lm import _filter_logits
+
+    logits = jnp.asarray([[3.0, 1.0, 2.0, 0.0]])
+    k2 = np.asarray(_filter_logits(logits, top_k=2, top_p=None))
+    assert np.isfinite(k2[0, [0, 2]]).all()
+    assert np.isneginf(k2[0, [1, 3]]).all()
+    # top_p tight enough to keep only the argmax
+    p_small = np.asarray(_filter_logits(logits, top_k=None, top_p=0.1))
+    assert np.isfinite(p_small[0, 0]) and np.isneginf(p_small[0, 1:]).all()
+    # top_p=1.0 keeps everything
+    p_all = np.asarray(_filter_logits(logits, top_k=None, top_p=1.0))
+    assert np.isfinite(p_all).all()
+
+
+def test_top_k_one_equals_greedy_and_samplers_agree():
+    g, model, state = _setup(seed=5)
+    buf = jnp.asarray(
+        np.random.default_rng(6).integers(0, 32, (8, 24), dtype=np.int32)
+    )
+    greedy = make_cached_lm_sample(g, model)
+    k1 = make_cached_lm_sample(g, model, temperature=1.0, top_k=1)
+    np.testing.assert_array_equal(
+        np.asarray(k1(state, buf, 4, jax.random.key(0))),
+        np.asarray(greedy(state, buf, 4, jax.random.key(0))),
+    )
+    # filtered stochastic sampling agrees across both implementations
+    a = make_cached_lm_sample(g, model, temperature=1.0, top_k=5, top_p=0.9)
+    b = make_lm_sample(g, model, temperature=1.0, top_k=5, top_p=0.9)
+    np.testing.assert_array_equal(
+        np.asarray(a(state, buf, 4, jax.random.key(3))),
+        np.asarray(b(state, buf, 4, jax.random.key(3))),
+    )
+
+
+def test_filter_logits_exact_on_ties_and_validates():
+    from multidisttorch_tpu.train.lm import _filter_logits
+
+    # uniform row: rank-based filtering still keeps exactly k / the
+    # top-p prefix (value thresholds would keep everything)
+    uniform = jnp.zeros((1, 8))
+    k3 = np.asarray(_filter_logits(uniform, top_k=3, top_p=None))
+    assert np.isfinite(k3).sum() == 3
+    p_small = np.asarray(_filter_logits(uniform, top_k=None, top_p=0.2))
+    assert np.isfinite(p_small).sum() == 2  # ceil to reach 0.2 of mass
+    # rank 0 is exactly argmax on ties (stable order)
+    tied = jnp.asarray([[1.0, 5.0, 5.0, 0.0]])
+    k1 = np.asarray(_filter_logits(tied, top_k=1, top_p=None))
+    assert np.isfinite(k1[0, 1]) and np.isneginf(k1[0, 2])
+    with pytest.raises(ValueError, match="top_k"):
+        _filter_logits(uniform, top_k=0, top_p=None)
+    with pytest.raises(ValueError, match="top_p"):
+        _filter_logits(uniform, top_k=None, top_p=1.5)
