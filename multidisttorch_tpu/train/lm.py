@@ -69,16 +69,32 @@ def _filter_logits(logits, top_k, top_p):
     return jnp.where(keep_vocab, logits, jnp.float32(-jnp.inf))
 
 
+def _validate_sampling(temperature, top_k, top_p) -> None:
+    """Build-time validation shared by both sampler factories: bad
+    values fail at construction, not on the first jitted call (and
+    filters are never silently dropped by a greedy temperature)."""
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k={top_k} must be >= 1")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p={top_p} must be in (0, 1]")
+    if temperature <= 0 and (top_k is not None or top_p is not None):
+        raise ValueError(
+            "top_k/top_p require temperature > 0 (greedy sampling "
+            "ignores filters; refusing to drop them silently)"
+        )
+
+
 def _sample_token(logits, rng, temperature, top_k, top_p):
     """One draw shared by both samplers: greedy at temperature 0, else
-    filtered softmax-temperature sampling. Returns (token, new_rng)."""
+    (optionally filtered) softmax-temperature sampling. Returns
+    ``(token, new_rng)``."""
     if temperature <= 0:
         return jnp.argmax(logits, axis=-1), rng
     rng, sub = jax.random.split(rng)
-    filtered = _filter_logits(
-        logits.astype(jnp.float32) / temperature, top_k, top_p
-    )
-    return jax.random.categorical(sub, filtered, axis=-1), rng
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None or top_p is not None:  # static: no-op filters
+        logits = _filter_logits(logits, top_k, top_p)  # cost nothing
+    return jax.random.categorical(sub, logits, axis=-1), rng
 
 
 def lm_loss_mean(logits: jax.Array, tokens: jax.Array) -> jax.Array:
@@ -248,6 +264,7 @@ def make_lm_sample(
     garbage. The buffer batch-shards over the trial's data axis like
     every other LM step (B must divide it).
     """
+    _validate_sampling(temperature, top_k, top_p)
     repl = trial.replicated_sharding
 
     def sample_fn(

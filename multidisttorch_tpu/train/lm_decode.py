@@ -66,6 +66,9 @@ def make_cached_lm_sample(
     position costs one cache-masked attention instead of a full-prefix
     forward.
     """
+    from multidisttorch_tpu.train.lm import _validate_sampling
+
+    _validate_sampling(temperature, top_k, top_p)
     if model.dtype != jnp.float32:
         raise ValueError(
             "make_cached_lm_sample implements float32 compute; for a "

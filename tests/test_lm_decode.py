@@ -185,3 +185,37 @@ def test_filter_logits_exact_on_ties_and_validates():
         _filter_logits(uniform, top_k=0, top_p=None)
     with pytest.raises(ValueError, match="top_p"):
         _filter_logits(uniform, top_k=None, top_p=1.5)
+
+
+def test_filter_logits_properties():
+    # Randomized property check: kept-count == min(k, nucleus size) and
+    # kept mass >= top_p for every row.
+    from multidisttorch_tpu.train.lm import _filter_logits
+
+    rng = np.random.default_rng(11)
+    logits = jnp.asarray(rng.normal(0, 2, (16, 33)).astype(np.float32))
+    for top_k, top_p in ((1, None), (7, None), (None, 0.5),
+                         (None, 0.99), (5, 0.7)):
+        out = np.asarray(_filter_logits(logits, top_k, top_p))
+        kept = np.isfinite(out)
+        if top_k is not None:
+            assert (kept.sum(-1) <= top_k).all()
+            if top_p is None:
+                assert (kept.sum(-1) == top_k).all()
+        if top_p is not None and top_k is None:
+            # kept set reaches the target mass
+            probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+            mass = (probs * kept).sum(-1)
+            assert (mass >= top_p - 1e-6).all()
+        # filtering never changes surviving values
+        np.testing.assert_array_equal(out[kept], np.asarray(logits)[kept])
+
+
+def test_sampler_factories_validate_at_build_time():
+    g, model, _ = _setup()
+    with pytest.raises(ValueError, match="top_p"):
+        make_cached_lm_sample(g, model, temperature=1.0, top_p=5.0)
+    with pytest.raises(ValueError, match="temperature > 0"):
+        make_cached_lm_sample(g, model, top_k=5)  # greedy would drop it
+    with pytest.raises(ValueError, match="top_k"):
+        make_lm_sample(g, model, temperature=1.0, top_k=0)
