@@ -1029,17 +1029,28 @@ def main():
         r = bench_suite()
         r["backend"] = backend
         flagship = r.get("flagship", {}).get("samples_per_sec_per_chip")
-        print(
-            json.dumps(
-                {
-                    "metric": "vae_train_samples_per_sec_per_chip",
-                    "value": flagship,
-                    "unit": "samples/sec/chip",
-                    "vs_baseline": None,
-                    "detail": r,
-                }
-            )
-        )
+        payload = {
+            "metric": "vae_train_samples_per_sec_per_chip",
+            "value": flagship,
+            "unit": "samples/sec/chip",
+            "vs_baseline": None,
+            "detail": r,
+        }
+        print(json.dumps(payload))  # the primary contract, always first
+        if backend.get("platform") == "tpu":
+            # Chip windows are rare and close without warning — also
+            # bank the evidence in the artifacts dir so a successful
+            # TPU suite can't be lost to a dropped stdout. Best-effort:
+            # the backup path must never kill the primary one.
+            try:
+                os.makedirs("artifacts", exist_ok=True)
+                path = "artifacts/bench_tpu_suite_latest.json"
+                with open(path, "w") as f:
+                    json.dump(payload, f)
+                print(f"banked TPU suite artifact: {path}",
+                      file=sys.stderr)
+            except OSError as e:
+                print(f"artifact banking failed: {e!r}", file=sys.stderr)
         return
 
     if args.lm:

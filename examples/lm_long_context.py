@@ -77,6 +77,13 @@ def main():
     args = parser.parse_args()
     if args.flash and args.ring_flash:
         parser.error("--flash and --ring-flash are mutually exclusive")
+    try:
+        # fail bad sampling combos in milliseconds, not after training
+        from multidisttorch_tpu.train.lm import _validate_sampling
+
+        _validate_sampling(args.temperature, args.top_k, args.top_p)
+    except ValueError as e:
+        parser.error(str(e))
 
     mdt.initialize_runtime()
     (g,) = mdt.setup_groups(1)

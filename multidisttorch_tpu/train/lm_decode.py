@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from multidisttorch_tpu.parallel.mesh import TrialMesh
-from multidisttorch_tpu.train.lm import _sample_token
+from multidisttorch_tpu.train.lm import _sample_token, _validate_sampling
 from multidisttorch_tpu.train.steps import TrainState
 
 _LN_EPS = 1e-6  # flax nn.LayerNorm default, which the model uses
@@ -66,8 +66,6 @@ def make_cached_lm_sample(
     position costs one cache-masked attention instead of a full-prefix
     forward.
     """
-    from multidisttorch_tpu.train.lm import _validate_sampling
-
     _validate_sampling(temperature, top_k, top_p)
     if model.dtype != jnp.float32:
         raise ValueError(
