@@ -42,10 +42,12 @@ BATCH = 128
 HIDDEN, LATENT = 400, 20
 CHUNK_STEPS = 100  # inner lax.scan steps per dispatch (make_multi_step)
 MEASURE_CHUNKS = 10
-MEASURE_REPEATS = 3  # timed passes per number; report the median. The
+MEASURE_REPEATS = 5  # timed passes per number; report the median. The
 # chip is reached through a tunnel with ~2x run-to-run throughput
 # variance (round 4: 6.5M vs 12.7M on the identical program) — one
-# pass is a coin flip, the median of three is a defensible number.
+# pass is a coin flip; five passes give a defensible median AND a
+# p10/p90 spread the artifact can report (VERDICT r4 item 4). Each
+# pass is ~128k samples, so the extra passes cost well under a second.
 TORCH_MEASURE_STEPS = 30
 
 PREFLIGHT_TIMEOUT_S = 120  # first TPU init is ~20-40s healthy; a wedged
@@ -361,14 +363,14 @@ def _flagship_setup(num_groups: int = 1):
     return groups, model, tx
 
 
-def _timed_chunks(trial, model, tx, **step_kwargs) -> float:
+def _timed_chunks(trial, model, tx, **step_kwargs) -> tuple[float, list]:
     """The one measurement protocol: scan-fused dispatch (CHUNK_STEPS
     optimizer updates per host round-trip — the TPU-idiomatic shape of
     the reference's per-batch loop, vae-hpo.py:67-74), one warmup
     compile, then MEASURE_REPEATS passes of MEASURE_CHUNKS timed chunks.
-    Returns the MEDIAN pass's samples/sec (whole submesh) — the tunnel
-    to the chip has ~2x run-to-run variance, so single-pass numbers
-    aren't defensible. Both single-trial throughput modes (the headline number
+    Returns ``(median, per_pass_rates)`` in samples/sec (whole submesh) —
+    the tunnel to the chip has ~2x run-to-run variance, so single-pass
+    numbers aren't defensible and the artifact reports the distribution. Both single-trial throughput modes (the headline number
     and the fused-loss comparison that decides defaults against it) go
     through here so those two can't drift; bench_concurrency and
     bench_to_elbo measure deliberately different things (interleaved
@@ -410,13 +412,25 @@ def _timed_chunks(trial, model, tx, **step_kwargs) -> float:
             jax.block_until_ready(state.params)
             dt = time.perf_counter() - t0
         rates.append(MEASURE_CHUNKS * CHUNK_STEPS * BATCH / dt)
-    return float(np.median(rates))
+    return float(np.median(rates)), rates
 
 
-def bench_ours() -> float:
+def bench_ours() -> dict:
+    """Flagship throughput with its pass distribution (VERDICT r4 #4):
+    median + p10/p90 over MEASURE_REPEATS timed windows in ONE process,
+    so the headline is never a single-shot coin flip through the
+    variable tunnel."""
     ndev = len(jax.devices())
     (trial,), model, tx = _flagship_setup(1)
-    return _timed_chunks(trial, model, tx) / ndev
+    med, rates = _timed_chunks(trial, model, tx)
+    per_chip = [r / ndev for r in rates]
+    return {
+        "samples_per_sec_per_chip": round(med / ndev, 1),
+        "pass_samples_per_sec_per_chip": [round(r, 1) for r in per_chip],
+        "p10": round(float(np.percentile(per_chip, 10)), 1),
+        "p90": round(float(np.percentile(per_chip, 90)), 1),
+        "passes": len(per_chip),
+    }
 
 
 def bench_fused_loss_comparison() -> dict:
@@ -432,9 +446,9 @@ def bench_fused_loss_comparison() -> dict:
     (trial,), model, tx = _flagship_setup(1)
     out = {}
     for label, fused in (("xla_loss", False), ("pallas_fused_loss", True)):
-        out[label + "_samples_per_sec"] = round(
-            _timed_chunks(trial, model, tx, use_fused_loss=fused), 1
-        )
+        med, rates = _timed_chunks(trial, model, tx, use_fused_loss=fused)
+        out[label + "_samples_per_sec"] = round(med, 1)
+        out[label + "_pass_rates"] = [round(r, 1) for r in rates]
     out["winner"] = (
         "pallas"
         if out["pallas_fused_loss_samples_per_sec"]
@@ -652,19 +666,119 @@ def bench_decode() -> dict:
     }
 
 
-def bench_suite() -> dict:
+def bench_kernel_smoke() -> dict:
+    """Per-kernel, per-dtype compiled pass/fail for the Pallas set.
+
+    VERDICT r4 item 3: interpret-mode tests cannot catch Mosaic dtype
+    rules (the round-4 bf16 ELBO store failure class), so the banked
+    suite artifact must itself prove each shipped kernel compiles and
+    matches its XLA reference on the hardware it ran on. Tiny shapes,
+    fwd AND bwd, f32 AND bf16 — run FIRST in the suite so a kernel
+    regression is recorded even if a later timing section crashes.
+    Off-TPU this still runs (interpret mode, semantics only); the
+    ``platform`` field says which kind of proof the artifact carries.
+    """
+    from multidisttorch_tpu.ops.losses import elbo_loss_sum
+    from multidisttorch_tpu.ops.pallas_attention import flash_attention
+    from multidisttorch_tpu.ops.pallas_elbo import fused_elbo_loss_sum
+    from multidisttorch_tpu.ops.ring_attention import dense_attention_reference
+
+    out = {"platform": jax.default_backend()}
+    rng = np.random.default_rng(0)
+
+    def check(name, fn):
+        t0 = time.perf_counter()
+        try:
+            fn()
+            out[name] = {"ok": True}
+        except Exception as e:
+            out[name] = {"ok": False, "error": repr(e)[:300]}
+        out[name]["wall_s"] = round(time.perf_counter() - t0, 1)
+
+    def rel_close(got, want, tol):
+        got, want = np.asarray(got, np.float64), np.asarray(want, np.float64)
+        denom = max(float(np.max(np.abs(want))), 1e-6)
+        err = float(np.max(np.abs(got - want))) / denom
+        if not err <= tol:  # explicit raise: `assert` dies under -O and
+            # would bank a false hardware proof (NaN err also lands here)
+            raise ValueError(f"kernel mismatch: rel err {err:.3e} > {tol}")
+
+    for dt_name, dt in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        # bf16 operands round at ~2^-8; sums over hundreds of terms in a
+        # shared-f32 accumulation still differ per-path at that scale.
+        tol = 3e-2 if dt == jnp.bfloat16 else 2e-4
+
+        def elbo_case(dt=dt, tol=tol):
+            # batch 256 forces a multi-block grid under the shrunken
+            # VMEM budget used in tests; here it just exercises the
+            # production accumulation path (same 784/20 widths as the
+            # flagship, targets f32 like the real train step feeds).
+            logits = jnp.asarray(rng.normal(size=(256, 784)), dt)
+            x = jnp.asarray(rng.uniform(size=(256, 784)), jnp.float32)
+            mu = jnp.asarray(rng.normal(size=(256, 20)), dt)
+            logvar = jnp.asarray(rng.normal(size=(256, 20)), dt)
+
+            def run(loss_fn):
+                f = lambda l, m, lv: loss_fn(l, x, m, lv, 1.0)
+                return jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))(
+                    logits, mu, logvar
+                )
+
+            (got, g_got), (want, g_want) = run(fused_elbo_loss_sum), run(
+                elbo_loss_sum
+            )
+            rel_close(got, want, tol)
+            for a, b in zip(g_got, g_want):
+                rel_close(a.astype(jnp.float32), b.astype(jnp.float32), tol)
+
+        check(f"fused_elbo_{dt_name}", elbo_case)
+
+        def flash_case(dt=dt, tol=tol):
+            # T=256 → the tiled 128-block grid path, fwd and bwd.
+            q, k, v = (
+                jnp.asarray(rng.normal(size=(1, 256, 2, 64)), dt)
+                for _ in range(3)
+            )
+
+            def run(attn):
+                f = lambda q, k, v: jnp.sum(
+                    attn(q, k, v, causal=True).astype(jnp.float32) ** 2
+                )
+                return jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))(
+                    q, k, v
+                )
+
+            (got, g_got), (want, g_want) = run(flash_attention), run(
+                dense_attention_reference
+            )
+            rel_close(got, want, tol)
+            for a, b in zip(g_got, g_want):
+                rel_close(a.astype(jnp.float32), b.astype(jnp.float32), tol)
+
+        check(f"flash_attention_{dt_name}", flash_case)
+    return out
+
+
+def bench_suite(checkpoint=None) -> dict:
     """Every measurement in ONE process, for one-shot chip windows.
 
     The machine's chip is intermittently available and rapid back-to-back
     processes re-wedge it (round-4 finding), so the way to bank a full
     set of hardware numbers is a single process that captures everything
     while it holds the tunnel. Each sub-bench is independent: a failure
-    records its error and the rest still run.
+    records its error and the rest still run. ``checkpoint``, if given,
+    is called with the partial results dict after EVERY section — a
+    wedged tunnel hangs rather than raising, so sections already
+    captured (kernel_smoke runs first for exactly this reason) must hit
+    disk before a later section can block until the driver kills us.
     """
     on_tpu = jax.default_backend() == "tpu"
     out = {}
     for name, fn in (
-        ("flagship", lambda: {"samples_per_sec_per_chip": round(bench_ours(), 1)}),
+        # Kernel pass/fail FIRST: cheapest section, and the one that
+        # must survive even if a timing section wedges the tunnel.
+        ("kernel_smoke", bench_kernel_smoke),
+        ("flagship", bench_ours),
         # Interpret-mode Pallas timings are meaningless and very slow —
         # same off-TPU gate as the default mode's comparison.
         ("fused_loss_comparison", bench_fused_loss_comparison if on_tpu
@@ -684,6 +798,11 @@ def bench_suite() -> dict:
         except Exception as e:  # record, keep banking the rest
             out[name] = {"error": repr(e)[:300]}
         out[name]["wall_s"] = round(time.perf_counter() - t0, 1)
+        if checkpoint is not None:
+            try:
+                checkpoint(out)
+            except OSError as e:  # never let banking kill the capture
+                print(f"suite checkpoint failed: {e!r}", file=sys.stderr)
     return out
 
 
@@ -977,6 +1096,72 @@ def bench_to_elbo(target: float, max_steps: int = 20000) -> dict:
     }
 
 
+def _last_tpu_artifact() -> dict | None:
+    """Newest banked real-TPU artifact, for embedding (marked stale) in
+    a CPU-fallback headline.
+
+    VERDICT r4 item 6: when the chip is wedged at the driver's capture
+    time, ``BENCH_r{N}.json`` records a CPU number that reads as a
+    ~570x regression unless the reader digs into ``artifacts/``. This
+    surfaces the evidence in the round headline itself: the most recent
+    ``artifacts/bench_tpu_*.json`` whose payload proves a real TPU run,
+    with heavyweight triage stripped and provenance (file, mtime) kept.
+    """
+    import glob
+
+    candidates = []
+    for p in glob.glob("artifacts/bench_tpu_*.json"):
+        if p.endswith("_latest.json"):
+            continue  # mutable alias of a timestamped file — not provenance
+        try:
+            with open(p) as f:
+                d = json.load(f)
+            mt = os.path.getmtime(p)
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            continue
+        if not isinstance(d, dict):  # stray non-artifact JSON in the dir
+            continue
+        det = d.get("detail") if isinstance(d.get("detail"), dict) else {}
+        back = det.get("backend") if isinstance(det.get("backend"), dict) else {}
+        plat = det.get("platform") or back.get("platform")
+        if plat != "tpu":
+            continue
+        # Rank healthy captures (non-null headline value) above degraded
+        # ones — a newer run whose flagship section errored must not
+        # shadow an older good number.
+        candidates.append((d.get("value") is not None, mt, p, d))
+    if not candidates:
+        return None
+    _, mt, p, d = max(candidates)
+    det = d.get("detail")
+    if isinstance(det, dict):  # triage blobs dwarf the numbers; drop them
+        det = {k: v for k, v in det.items() if "triage" not in k}
+        if isinstance(det.get("backend"), dict):
+            det["backend"] = {
+                k: v for k, v in det["backend"].items() if "triage" not in k
+            }
+        d = {**d, "detail": det}
+    return {
+        "stale": True,
+        "file": p,
+        "captured_utc": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mt)
+        ),
+        "payload": d,
+    }
+
+
+def _embed_stale_tpu_evidence(target: dict, backend: dict) -> None:
+    """On a CPU fallback (chip wedged at capture time), surface the most
+    recent banked real-TPU artifact inside the emitted detail (VERDICT
+    r4 item 6). One shared guard so the suite and default paths cannot
+    drift."""
+    if backend.get("platform") == "cpu" and "tpu_error" in backend:
+        art = _last_tpu_artifact()
+        if art:
+            target["last_tpu_artifact"] = art
+
+
 def main():
     import argparse
 
@@ -1026,28 +1211,49 @@ def main():
     backend = _ensure_backend()
 
     if args.suite:
-        r = bench_suite()
-        r["backend"] = backend
-        flagship = r.get("flagship", {}).get("samples_per_sec_per_chip")
-        payload = {
-            "metric": "vae_train_samples_per_sec_per_chip",
-            "value": flagship,
-            "unit": "samples/sec/chip",
-            "vs_baseline": None,
-            "detail": r,
-        }
-        print(json.dumps(payload))  # the primary contract, always first
+        # Chip windows are rare and close without warning, and a wedged
+        # tunnel HANGS rather than raising — so on TPU the suite banks
+        # its evidence incrementally after every section, to a unique
+        # per-run filename (ADVICE r4: a later degraded run must never
+        # clobber a previously banked good capture) plus a refreshed
+        # _latest alias at the end. Best-effort throughout: the backup
+        # path must never kill the primary stdout contract.
+        bank_path = None
         if backend.get("platform") == "tpu":
-            # Chip windows are rare and close without warning — also
-            # bank the evidence in the artifacts dir so a successful
-            # TPU suite can't be lost to a dropped stdout. Best-effort:
-            # the backup path must never kill the primary one.
             try:
                 os.makedirs("artifacts", exist_ok=True)
-                path = "artifacts/bench_tpu_suite_latest.json"
-                with open(path, "w") as f:
+                stamp = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
+                bank_path = f"artifacts/bench_tpu_suite_{stamp}.json"
+            except OSError as e:
+                print(f"artifact dir unavailable: {e!r}", file=sys.stderr)
+
+        def payload_for(results: dict) -> dict:
+            flagship = results.get("flagship", {})
+            return {
+                "metric": "vae_train_samples_per_sec_per_chip",
+                "value": flagship.get("samples_per_sec_per_chip")
+                if isinstance(flagship, dict) else None,
+                "unit": "samples/sec/chip",
+                "vs_baseline": None,
+                "detail": {**results, "backend": backend},
+            }
+
+        def checkpoint(partial: dict) -> None:
+            if bank_path:  # marked partial until the final write lands
+                with open(bank_path, "w") as f:
+                    json.dump({**payload_for(partial), "partial": True}, f)
+
+        r = bench_suite(checkpoint)
+        _embed_stale_tpu_evidence(r, backend)
+        payload = payload_for(r)
+        print(json.dumps(payload))  # the primary contract, always first
+        if bank_path:
+            try:
+                with open(bank_path, "w") as f:
                     json.dump(payload, f)
-                print(f"banked TPU suite artifact: {path}",
+                with open("artifacts/bench_tpu_suite_latest.json", "w") as f:
+                    json.dump({**payload, "banked_as": bank_path}, f)
+                print(f"banked TPU suite artifact: {bank_path}",
                       file=sys.stderr)
             except OSError as e:
                 print(f"artifact banking failed: {e!r}", file=sys.stderr)
@@ -1143,7 +1349,8 @@ def main():
         )
         return
 
-    ours = bench_ours()
+    flagship_stats = bench_ours()
+    ours = flagship_stats["samples_per_sec_per_chip"]
     try:
         ref = bench_reference_torch()
     except Exception as e:
@@ -1160,6 +1367,8 @@ def main():
     )
     mfu = (ours * _train_flops_per_sample() / peak) if peak else None
     detail = dict(backend)
+    detail["flagship_passes"] = flagship_stats
+    _embed_stale_tpu_evidence(detail, backend)
     if peak:
         detail["peak_flops_per_chip"] = peak
         detail["train_flops_per_sample"] = _train_flops_per_sample()
