@@ -1238,10 +1238,20 @@ def main():
                 "detail": {**results, "backend": backend},
             }
 
+        def bank(payload: dict) -> None:
+            # Atomic replace: an in-place "w" rewrite would truncate
+            # the artifact first, so a mid-write kill (the driver's
+            # timeout) or disk-full would destroy every previously
+            # banked section — the exact loss the incremental
+            # checkpointing exists to prevent.
+            tmp = bank_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, bank_path)
+
         def checkpoint(partial: dict) -> None:
             if bank_path:  # marked partial until the final write lands
-                with open(bank_path, "w") as f:
-                    json.dump({**payload_for(partial), "partial": True}, f)
+                bank({**payload_for(partial), "partial": True})
 
         r = bench_suite(checkpoint)
         _embed_stale_tpu_evidence(r, backend)
@@ -1249,8 +1259,7 @@ def main():
         print(json.dumps(payload))  # the primary contract, always first
         if bank_path:
             try:
-                with open(bank_path, "w") as f:
-                    json.dump(payload, f)
+                bank(payload)
                 with open("artifacts/bench_tpu_suite_latest.json", "w") as f:
                     json.dump({**payload, "banked_as": bank_path}, f)
                 print(f"banked TPU suite artifact: {bank_path}",

@@ -204,7 +204,12 @@ def transformer_tp_shardings(
     if shard_attention == "auto":
         # per-head-local attention paths: the dense default, or a ring
         # built with head sharding (its shard_map splits heads over the
-        # model axis itself — fn.head_sharded marks it)
+        # model axis itself — fn.head_sharded marks it). A plain flash
+        # callable sets head_sharded=False explicitly: its single
+        # unsharded pallas_call can't be split by GSPMD, so replicated
+        # projections are the deliberate choice, not a fallthrough
+        # (see make_flash_attention's docstring for the TP-capable
+        # ring-flash alternative).
         per_head_local = model.attention is None or getattr(
             model.attention, "head_sharded", False
         )

@@ -49,9 +49,29 @@ def _interpret() -> bool:
 _BLOCK = 128
 _NEG_INF = -1e30  # finite sentinel: -inf rows poison exp() on the VPU
 
+# Largest non-128-divisible T allowed to run as one whole-sequence
+# block. The whole-block path keeps the (T, T) f32 score tile plus
+# three (T, d) operand tiles resident in VMEM — ~4.5 MB at T=1024,
+# d=64, comfortably inside a v5e core's budget; at T=8256 the score
+# tile alone is 272 MB and the kernel fails at Mosaic compile time.
+# Above this, causal inputs are padded to the tile edge (exact — see
+# flash_attention) and non-causal inputs get a clear error instead of
+# a compile-time blowup (ADVICE r4).
+_MAX_WHOLE_BLOCK = 1024
+
 
 def _blocks(t: int) -> int:
     return _BLOCK if t % _BLOCK == 0 else t
+
+
+def _out_struct(shape, dtype, like):
+    """``ShapeDtypeStruct`` carrying the operands' varying-mesh-axes
+    type. Under a ``check_vma=True`` ``shard_map`` (e.g. the pipeline's
+    staged forward, parallel/pipeline.py) a pallas_call must declare
+    its outputs' VMA explicitly or tracing rejects it; propagating the
+    input's vma makes the kernels VMA-transparent (outside shard_map
+    ``typeof(x).vma`` is empty and this is a no-op)."""
+    return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
 
 
 # ---------------------------------------------------------------------
@@ -146,8 +166,8 @@ def _fwd_call(q, k, v, scale, causal):
                          memory_space=pltpu.VMEM),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((bh, t, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            _out_struct((bh, t, d), q.dtype, q),
+            _out_struct((bh, t), jnp.float32, q),
         ),
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),   # acc
@@ -306,7 +326,7 @@ def _bwd_call(q, k, v, o, lse, do, scale, causal, g_lse=None):
         grid=(bh, t // bq, t // bk),
         in_specs=[wide(bq), other(bk), other(bk), wide(bq), row, row],
         out_specs=wide(bq),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=_out_struct(q.shape, q.dtype, q),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -322,8 +342,8 @@ def _bwd_call(q, k, v, o, lse, do, scale, causal, g_lse=None):
                   other_row, other_row],
         out_specs=(wide(bk), wide(bk)),
         out_shape=(
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            _out_struct(k.shape, k.dtype, k),
+            _out_struct(v.shape, v.dtype, v),
         ),
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -378,13 +398,33 @@ def flash_attention(q, k, v, *, causal: bool = False):
     ``q, k, v``: ``(batch, seq, heads, head_dim)``, bf16 or f32. Scores
     and the softmax never touch HBM; memory is O(T·D) instead of O(T²).
     Sequences that are a multiple of 128 tile at the MXU edge; shorter
-    or non-divisible sequences run as one whole-sequence block (fine
-    for small T — a huge non-divisible T should be padded by the
-    caller instead).
+    non-divisible sequences (≤ ``_MAX_WHOLE_BLOCK``) run as one
+    whole-sequence block. A LARGE non-divisible T is handled per the
+    mask structure: causal inputs are zero-padded up to the tile edge
+    and the output sliced back — exact, because the causal mask keeps
+    every real query from seeing the appended keys, and the sliced
+    rows carry zero cotangent so padded queries contribute nothing to
+    dK/dV — while non-causal inputs (where appended keys WOULD be
+    attended) raise instead of blowing VMEM at Mosaic compile time.
     """
     if not _HAVE_PALLAS:
         return dense_attention_reference(q, k, v, causal=causal)
     b, t, h, d = q.shape
+    if t % _BLOCK and t > _MAX_WHOLE_BLOCK:
+        if not causal:
+            raise ValueError(
+                f"flash_attention: non-causal seq_len {t} is neither a "
+                f"multiple of {_BLOCK} nor small enough "
+                f"(<= {_MAX_WHOLE_BLOCK}) for the whole-sequence block "
+                f"path; pad the sequence to a multiple of {_BLOCK} and "
+                "mask in the caller"
+            )
+        pad = -t % _BLOCK
+        spec = ((0, 0), (0, pad), (0, 0), (0, 0))
+        return flash_attention(
+            jnp.pad(q, spec), jnp.pad(k, spec), jnp.pad(v, spec),
+            causal=True,
+        )[:, :t]
     scale = 1.0 / (d**0.5)
     # (B, T, H, D) -> (B*H, T, D): each (batch, head) pair is an
     # independent attention problem and a grid row.
@@ -395,8 +435,28 @@ def flash_attention(q, k, v, *, causal: bool = False):
 
 def make_flash_attention(*, causal: bool = True):
     """An ``attention=`` callable for :class:`models.transformer
-    .TransformerLM` using the Pallas kernel on the chip-local sequence."""
-    return partial(flash_attention, causal=causal)
+    .TransformerLM` using the Pallas kernel on the chip-local sequence.
+
+    TP note (ADVICE r4): the math is per-head-local, but the callable
+    runs as one ``pallas_call`` under ``jit`` with no partitioning
+    spec, so GSPMD cannot split it over a model axis —
+    ``transformer_tp_shardings(..., "auto")`` therefore keeps the
+    attention projections replicated when this callable is installed.
+    That decision is signaled explicitly via ``head_sharded = False``
+    (the same introspection attribute the ring factories set) rather
+    than falling out of a missing attribute. For head-parallel TP with
+    flash semantics, use :func:`make_ring_flash_attention` with
+    ``shard_heads="auto"`` — its ``shard_map`` places one flash kernel
+    per model-axis shard.
+    """
+
+    def attn(q, k, v):
+        return flash_attention(q, k, v, causal=causal)
+
+    attn.head_sharded = False  # single unsharded pallas_call: auto TP
+    # must keep q/k/v/proj replicated for this callable
+    attn.carries_collectives = False  # safe inside a pipeline stage
+    return attn
 
 
 # ---------------------------------------------------------------------

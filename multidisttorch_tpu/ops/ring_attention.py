@@ -170,6 +170,10 @@ def _wrap_head_check(inner, mesh: Mesh, head_axis: str | None):
         return inner(q, k, v)
 
     fn.head_sharded = head_axis is not None
+    # Ring callables always run a shard_map with ppermute hops — the
+    # marker pipeline staging checks (a collective cannot execute
+    # inside a lax.switch branch only some devices take).
+    fn.carries_collectives = True
     return fn
 
 

@@ -56,3 +56,39 @@ def fsdp_param_shardings(
         return trial.sharding(*spec)
 
     return jax.tree.map(rule, params)
+
+
+def fsdp_compose_shardings(
+    trial: TrialMesh, params: Any, base_shardings: Any, *,
+    min_size: int = 1024,
+) -> Any:
+    """Layer ZeRO data-axis sharding on top of an existing sharding tree.
+
+    The Megatron + ZeRO-3 composition: ``base_shardings`` (typically a
+    tensor-parallel tree like ``vae_tp_shardings`` /
+    ``transformer_tp_shardings``) says which dims ride the ``model``
+    axis; this adds ``data``-axis sharding on the largest
+    data-divisible dim each base spec leaves unsharded, so parameters
+    and Adam moments split over BOTH axes of a 2-D submesh. Leaves the
+    base untouched where it already covers every dim, where the leaf is
+    small (< ``min_size`` elements), or where no free dim divides the
+    data extent. GSPMD turns the annotations into the all-gather /
+    reduce-scatter schedule exactly as in the 1-D case.
+    """
+    n = trial.data_size
+
+    def rule(leaf, base):
+        if leaf.size < min_size:
+            return base
+        spec = list(base.spec) + [None] * (leaf.ndim - len(base.spec))
+        free = [
+            (dim, i) for i, dim in enumerate(leaf.shape)
+            if spec[i] is None and dim % n == 0
+        ]
+        if not free:
+            return base
+        _, axis = max(free)
+        spec[axis] = DATA_AXIS
+        return trial.sharding(*spec)
+
+    return jax.tree.map(rule, params, base_shardings)

@@ -69,12 +69,18 @@ def _filter_logits(logits, top_k, top_p):
     return jnp.where(keep_vocab, logits, jnp.float32(-jnp.inf))
 
 
-def _validate_sampling(temperature, top_k, top_p) -> None:
+def _validate_sampling(temperature, top_k, top_p, vocab_size=None) -> None:
     """Build-time validation shared by both sampler factories: bad
     values fail at construction, not on the first jitted call (and
-    filters are never silently dropped by a greedy temperature)."""
+    filters are never silently dropped by a greedy temperature).
+    Factories know their model's vocab, so an out-of-range ``top_k``
+    is also a construction error, not a first-call trace error."""
     if top_k is not None and top_k < 1:
         raise ValueError(f"top_k={top_k} must be >= 1")
+    if top_k is not None and vocab_size is not None and top_k > vocab_size:
+        raise ValueError(
+            f"top_k={top_k} exceeds the model's vocab_size={vocab_size}"
+        )
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p={top_p} must be in (0, 1]")
     if temperature <= 0 and (top_k is not None or top_p is not None):
@@ -264,7 +270,9 @@ def make_lm_sample(
     garbage. The buffer batch-shards over the trial's data axis like
     every other LM step (B must divide it).
     """
-    _validate_sampling(temperature, top_k, top_p)
+    _validate_sampling(
+        temperature, top_k, top_p, getattr(model, "vocab_size", None)
+    )
     repl = trial.replicated_sharding
 
     def sample_fn(

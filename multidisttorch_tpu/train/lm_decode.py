@@ -66,7 +66,9 @@ def make_cached_lm_sample(
     position costs one cache-masked attention instead of a full-prefix
     forward.
     """
-    _validate_sampling(temperature, top_k, top_p)
+    _validate_sampling(
+        temperature, top_k, top_p, getattr(model, "vocab_size", None)
+    )
     if model.dtype != jnp.float32:
         raise ValueError(
             "make_cached_lm_sample implements float32 compute; for a "

@@ -82,11 +82,12 @@ def make_pipelined_lm(
             "setup_groups(..., pipeline_parallel=S)"
         )
     attn = attention if attention is not None else model.attention
-    # Both ring factories mark their callables with .head_sharded
-    # (True or False) — any marked callable carries shard_map
-    # collectives, which cannot run inside a lax.switch stage branch
-    # that only some devices execute.
-    if hasattr(attn, "head_sharded"):
+    # Ring factories mark their callables carries_collectives=True
+    # (shard_map + ppermute hops), which cannot run inside a lax.switch
+    # stage branch that only some devices execute. Checked by VALUE,
+    # not hasattr: make_flash_attention() sets it False and is staged
+    # fine (a plain pallas_call is collective-free).
+    if getattr(attn, "carries_collectives", False):
         raise ValueError(
             "staged attention must be collective-free; a ring callable "
             "cannot run inside a pipeline stage (use the dense default "

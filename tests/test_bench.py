@@ -193,6 +193,7 @@ def test_last_tpu_artifact_robust_ranking(tmp_path, monkeypatch):
     assert got["file"].endswith("bench_tpu_degraded.json")
 
 
+@pytest.mark.slow  # spawns a full bench subprocess (~1 min)
 def test_cli_emits_one_json_line():
     # The driver contract: stdout is exactly one parseable JSON object
     # with the required keys. Use the cheap loader mode to keep the

@@ -55,6 +55,70 @@ def test_fsdp_state_is_sharded_including_adam_moments():
     assert mu.addressable_shards[0].data.shape == (98, 32)
 
 
+def test_fsdp_tp_composition_shards_both_axes():
+    # Megatron + ZeRO-3: on a (4 data x 2 model) submesh the composed
+    # rule adds data-axis sharding only on dims the TP spec leaves
+    # free, and skips small leaves entirely.
+    from multidisttorch_tpu.models.vae import vae_tp_shardings
+    from multidisttorch_tpu.parallel.fsdp import fsdp_compose_shardings
+    from multidisttorch_tpu.parallel.mesh import MODEL_AXIS
+
+    (g,) = setup_groups(1, model_parallel=2)
+    model = VAE(hidden_dim=32, latent_dim=8)
+    params = model.init(
+        {"params": jax.random.key(0), "reparam": jax.random.key(0)},
+        jnp.zeros((1, 784), jnp.float32),
+    )["params"]
+    sh = fsdp_compose_shardings(g, params, vae_tp_shardings(g))
+    # column-parallel fc1 (784, 32): model on dim 1 from TP, data added
+    # on the free dim 0 (784 % 4 == 0)
+    assert sh["fc1"]["kernel"].spec == P(DATA_AXIS, MODEL_AXIS)
+    # row-parallel fc4 (32, 784): model on dim 0, data added on dim 1
+    assert sh["fc4"]["kernel"].spec == P(MODEL_AXIS, DATA_AXIS)
+    # small leaves keep their base spec untouched
+    assert sh["fc3"]["kernel"].spec == vae_tp_shardings(g)["fc3"]["kernel"].spec
+    assert sh["fc1"]["bias"].spec == vae_tp_shardings(g)["fc1"]["bias"].spec
+
+
+def test_fsdp_tp_training_matches_tp_only():
+    # The composition is a LAYOUT change, not a math change: training on
+    # the same (data x model) submesh with and without the ZeRO layer
+    # must produce the same losses.
+    from multidisttorch_tpu.models.vae import vae_tp_shardings
+    from multidisttorch_tpu.parallel.fsdp import fsdp_compose_shardings
+
+    def losses(compose: bool, steps: int = 3):
+        (g,) = setup_groups(1, model_parallel=2)
+        model = VAE(hidden_dim=32, latent_dim=8)
+        tx = optax.adam(1e-3)
+        params = model.init(
+            {"params": jax.random.key(0), "reparam": jax.random.key(0)},
+            jnp.zeros((1, 784), jnp.float32),
+        )["params"]
+        sh = vae_tp_shardings(g)
+        if compose:
+            sh = fsdp_compose_shardings(g, params, sh)
+        state = create_train_state(
+            g, model, tx, jax.random.key(0), param_shardings=sh
+        )
+        step = make_train_step(g, model, tx, shardings=state_shardings(state))
+        batch = jax.device_put(
+            jnp.asarray(
+                np.random.default_rng(0)
+                .uniform(0, 1, (16, 784))
+                .astype(np.float32)
+            ),
+            g.batch_sharding,
+        )
+        out = []
+        for i in range(steps):
+            state, metrics = step(state, batch, jax.random.key(i))
+            out.append(float(metrics["loss_sum"]))
+        return out
+
+    np.testing.assert_allclose(losses(True), losses(False), rtol=1e-5)
+
+
 def test_fsdp_training_matches_replicated_dp():
     def losses(fsdp: bool, steps: int = 4):
         (g,) = setup_groups(1)

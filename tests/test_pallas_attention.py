@@ -84,6 +84,44 @@ def test_odd_head_dim_and_seq():
     )
 
 
+def test_large_nondivisible_causal_pads_exactly(monkeypatch):
+    # A non-128-divisible T above the whole-block threshold must take
+    # the pad-to-tile-edge path and stay exact, values AND gradients
+    # (padded keys are causally unreachable; sliced rows carry zero
+    # cotangent). Shrink the threshold so T=200 exercises it cheaply.
+    import multidisttorch_tpu.ops.pallas_attention as pa
+
+    monkeypatch.setattr(pa, "_MAX_WHOLE_BLOCK", 64)
+    q, k, v = _qkv(b=1, t=200, h=1, d=8, seed=3)
+    out = flash_attention(q, k, v, causal=True)
+    assert out.shape == q.shape
+    ref = dense_attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
+    )
+    loss = lambda fn: lambda q, k, v: jnp.sum(fn(q, k, v, causal=True) ** 2)
+    g = jax.grad(loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss(dense_attention_reference), argnums=(0, 1, 2))(
+        q, k, v
+    )
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-6
+        )
+
+
+def test_large_nondivisible_noncausal_raises(monkeypatch):
+    # Non-causal can't be padded exactly (appended keys WOULD be
+    # attended); the documented contract is a clear error instead of a
+    # VMEM blowup at Mosaic compile time (ADVICE r4).
+    import multidisttorch_tpu.ops.pallas_attention as pa
+
+    monkeypatch.setattr(pa, "_MAX_WHOLE_BLOCK", 64)
+    q, k, v = _qkv(b=1, t=200, h=1, d=8)
+    with pytest.raises(ValueError, match="multiple of 128"):
+        flash_attention(q, k, v, causal=False)
+
+
 def test_bf16_roundtrip():
     q, k, v = _qkv(t=64, dtype=np.float32)
     qb, kb, vb = (a.astype(jnp.bfloat16) for a in (q, k, v))

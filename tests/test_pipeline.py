@@ -336,6 +336,45 @@ def test_pipelined_lm_matches_plain_model():
     )
 
 
+def test_pipelined_lm_accepts_flash_and_rejects_ring():
+    # The staging gate checks carries_collectives by VALUE: the flash
+    # callable (collective-free pallas_call, marked False) stages fine
+    # and matches the dense-staged forward exactly; a ring callable
+    # (shard_map + ppermute, marked True) is rejected with the
+    # documented error.
+    from multidisttorch_tpu.models.transformer import TransformerLM
+    from multidisttorch_tpu.ops.pallas_attention import make_flash_attention
+    from multidisttorch_tpu.ops.ring_attention import make_ring_attention
+    from multidisttorch_tpu.train.lm_pipeline import (
+        make_pipelined_lm,
+        stage_params_sharding,
+    )
+
+    (trial,) = setup_groups(1, pipeline_parallel=2)
+    model = TransformerLM(
+        vocab_size=32, d_model=16, num_heads=2, num_layers=2, max_len=16
+    )
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(0, 32, (8, 16), dtype=np.int32)
+    )
+    params = model.init({"params": jax.random.key(0)}, tokens)["params"]
+    apply, packed, outer = make_pipelined_lm(
+        trial, model, params, num_microbatches=2,
+        attention=make_flash_attention(causal=True),
+    )
+    packed = jax.device_put(packed, stage_params_sharding(trial))
+    got = apply(packed, outer, tokens)
+    want = model.apply({"params": params}, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+    )
+    with pytest.raises(ValueError, match="collective-free"):
+        make_pipelined_lm(
+            trial, model, params, num_microbatches=2,
+            attention=make_ring_attention(trial, causal=True),
+        )
+
+
 def test_pipelined_lm_bf16_close_to_plain_model():
     # A bf16 model keeps its compute dtype inside the stages; the f32
     # inter-stage carry costs one cast per boundary, so parity is

@@ -406,6 +406,19 @@ def test_tp_auto_follows_ring_head_sharding():
     assert MODEL_AXIS not in tuple(sh["block_0"]["q"]["kernel"].spec)
     assert MODEL_AXIS in tuple(sh["block_0"]["up"]["kernel"].spec)
 
+    # A plain flash callable signals head_sharded=False EXPLICITLY (its
+    # single unsharded pallas_call can't be split by GSPMD), so "auto"
+    # deliberately keeps the attention projections replicated while the
+    # MLP still shards (ADVICE r4).
+    from multidisttorch_tpu.ops.pallas_attention import make_flash_attention
+
+    flash = make_flash_attention(causal=True)
+    assert flash.head_sharded is False
+    assert flash.carries_collectives is False  # stageable in a pipeline
+    sh = transformer_tp_shardings(g, TransformerLM(attention=flash, **cfg))
+    assert MODEL_AXIS not in tuple(sh["block_0"]["q"]["kernel"].spec)
+    assert MODEL_AXIS in tuple(sh["block_0"]["up"]["kernel"].spec)
+
 
 def test_lm_sampling_reproduces_learned_pattern():
     # Train on the deterministic periodic corpus, then greedy-decode
