@@ -41,6 +41,12 @@ import optax
 BATCH = 128
 HIDDEN, LATENT = 400, 20
 CHUNK_STEPS = 100  # inner lax.scan steps per dispatch (make_multi_step)
+CHUNK_STEPS_TPU = 1000  # on the real chip a 100-step chunk is ~1 ms of
+# device time at the recorded rate — the same order as ONE host enqueue
+# (docs/DISPATCH.md), so the flagship was host-bound on TPU. 1000 steps
+# ≈ 10 ms device per dispatch (enqueue ≪ compute) at 401 MB of stacked
+# batch data — comfortable in 16 GB HBM. CPU runs keep the smaller
+# chunk (compute-bound there; bigger chunks only slow the fallback).
 MEASURE_CHUNKS = 10
 MEASURE_REPEATS = 5  # timed passes per number; report the median. The
 # chip is reached through a tunnel with ~2x run-to-run throughput
@@ -378,12 +384,15 @@ def _timed_chunks(trial, model, tx, **step_kwargs) -> tuple[float, list]:
     from multidisttorch_tpu.train.steps import create_train_state, make_multi_step
     from multidisttorch_tpu.utils.profiling import profile_trace
 
+    chunk = (
+        CHUNK_STEPS_TPU if jax.default_backend() == "tpu" else CHUNK_STEPS
+    )
     state = create_train_state(trial, model, tx, jax.random.key(0))
     multi = make_multi_step(trial, model, tx, **step_kwargs)
     batches = jax.device_put(
         jnp.asarray(
             np.random.default_rng(0)
-            .uniform(0, 1, (CHUNK_STEPS, BATCH, 784))
+            .uniform(0, 1, (chunk, BATCH, 784))
             .astype(np.float32)
         ),
         trial.sharding(None, "data"),
@@ -411,7 +420,7 @@ def _timed_chunks(trial, model, tx, **step_kwargs) -> tuple[float, list]:
                 )
             jax.block_until_ready(state.params)
             dt = time.perf_counter() - t0
-        rates.append(MEASURE_CHUNKS * CHUNK_STEPS * BATCH / dt)
+        rates.append(MEASURE_CHUNKS * chunk * BATCH / dt)
     return float(np.median(rates)), rates
 
 
@@ -461,8 +470,12 @@ def bench_fused_loss_comparison() -> dict:
 # LM bench shape: sized so one TPU v5e chip (16 GB HBM) is comfortably
 # matmul-dominated — the MFU story the tiny flagship VAE cannot tell
 # (its 784x400 matmuls are dispatch/bandwidth-bound by construction).
+# LM_STEPS optimizer updates run as ONE scan-fused dispatch
+# (make_lm_multi_step): at ~1 ms of device time per step on a v5e, a
+# step-per-dispatch loop would time the host, not the MXU
+# (docs/DISPATCH.md).
 LM_VOCAB, LM_DMODEL, LM_HEADS, LM_LAYERS = 32768, 512, 8, 8
-LM_SEQ, LM_BATCH, LM_STEPS = 512, 16, 20
+LM_SEQ, LM_BATCH, LM_STEPS = 512, 16, 40
 
 
 def _lm_train_flops_per_token(
@@ -504,19 +517,21 @@ def bench_lm() -> dict:
     from multidisttorch_tpu.models.transformer import TransformerLM
     from multidisttorch_tpu.ops.pallas_attention import make_flash_attention
     from multidisttorch_tpu.parallel.mesh import setup_groups
-    from multidisttorch_tpu.train.lm import create_lm_state, make_lm_train_step
+    from multidisttorch_tpu.train.lm import create_lm_state, make_lm_multi_step
 
     (trial,) = setup_groups(1)
     on_tpu = jax.default_backend() == "tpu"
     dtype = jnp.bfloat16 if on_tpu else jnp.float32
     tx = optax.adam(1e-3)
-    tokens = jax.device_put(
+    # (LM_STEPS, B, T) stacked chunk, batch-sharded on dim 1 — one
+    # scan-fused dispatch per timed pass.
+    chunks = jax.device_put(
         jnp.asarray(
             np.random.default_rng(0).integers(
-                0, LM_VOCAB, (LM_BATCH, LM_SEQ), dtype=np.int32
+                0, LM_VOCAB, (LM_STEPS, LM_BATCH, LM_SEQ), dtype=np.int32
             )
         ),
-        trial.batch_sharding,
+        trial.sharding(None, "data", None),
     )
 
     def timed(attention) -> tuple[float, list, float]:
@@ -528,19 +543,18 @@ def bench_lm() -> dict:
         state = create_lm_state(
             trial, model, tx, jax.random.key(0), example_len=LM_SEQ
         )
-        step = make_lm_train_step(trial, model, tx)
-        state, _ = step(state, tokens)  # compile + warmup
+        multi = make_lm_multi_step(trial, model, tx)
+        state, _ = multi(state, chunks)  # compile + warmup
         jax.block_until_ready(state.params)
         rates = []
         for _ in range(MEASURE_REPEATS):
             t0 = time.perf_counter()
-            for _ in range(LM_STEPS):
-                state, metrics = step(state, tokens)
+            state, metrics = multi(state, chunks)
             jax.block_until_ready(state.params)
             rates.append(
                 LM_STEPS * LM_BATCH * LM_SEQ / (time.perf_counter() - t0)
             )
-        return float(np.median(rates)), rates, float(metrics["loss"])
+        return float(np.median(rates)), rates, float(metrics["loss"][-1])
 
     variants = {"dense_xla": timed(None)}
     flash_error = None
@@ -861,8 +875,14 @@ def bench_concurrency(num_trials: int) -> dict:
     from multidisttorch_tpu.train.steps import create_train_state, make_multi_step
 
     groups, model, tx = _flagship_setup(num_trials)
+    # Same TPU chunk sizing as the flagship timing (docs/DISPATCH.md):
+    # 100-step chunks on real chips would make this measure the host
+    # loop, not per-trial chip efficiency.
+    chunk = (
+        CHUNK_STEPS_TPU if jax.default_backend() == "tpu" else CHUNK_STEPS
+    )
     batches_np = np.random.default_rng(0).uniform(
-        0, 1, (CHUNK_STEPS, BATCH, 784)
+        0, 1, (chunk, BATCH, 784)
     ).astype(np.float32)
     key = jax.random.key(1)
 
@@ -894,15 +914,15 @@ def bench_concurrency(num_trials: int) -> dict:
     t0 = time.perf_counter()
     run_chunks(trials[:1], MEASURE_CHUNKS)
     alone_sps = (
-        MEASURE_CHUNKS * CHUNK_STEPS * BATCH / (time.perf_counter() - t0)
+        MEASURE_CHUNKS * chunk * BATCH / (time.perf_counter() - t0)
     )
 
     # all trials concurrently
     t0 = time.perf_counter()
     run_chunks(trials, MEASURE_CHUNKS)
     dt = time.perf_counter() - t0
-    # each trial did MEASURE_CHUNKS * CHUNK_STEPS steps
-    per_trial_sps = MEASURE_CHUNKS * CHUNK_STEPS * BATCH / dt
+    # each trial did MEASURE_CHUNKS * chunk steps
+    per_trial_sps = MEASURE_CHUNKS * chunk * BATCH / dt
 
     ndev = len(jax.devices())
     out = {

@@ -38,24 +38,16 @@ def fsdp_param_shardings(
     Returns a pytree of ``NamedSharding`` matching ``params`` — pass to
     ``create_train_state(..., param_shardings=...)`` /
     ``create_classifier_state``.
+
+    Implemented as the composition rule over an all-replicated base, so
+    the 1-D and layered (ZeRO-over-TP) paths share ONE dim-selection
+    rule and cannot drift.
     """
-    n = trial.data_size
     repl = trial.sharding()
-
-    def rule(leaf):
-        if leaf.size < min_size:
-            return repl
-        divisible = [
-            (dim, i) for i, dim in enumerate(leaf.shape) if dim % n == 0
-        ]
-        if not divisible:
-            return repl
-        _, axis = max(divisible)
-        spec = [None] * leaf.ndim
-        spec[axis] = DATA_AXIS
-        return trial.sharding(*spec)
-
-    return jax.tree.map(rule, params)
+    return fsdp_compose_shardings(
+        trial, params, jax.tree.map(lambda _: repl, params),
+        min_size=min_size,
+    )
 
 
 def fsdp_compose_shardings(

@@ -2,6 +2,7 @@ from multidisttorch_tpu.train.lm import (
     create_lm_state,
     lm_loss_mean,
     make_lm_eval_step,
+    make_lm_multi_step,
     make_lm_sample,
     make_lm_train_step,
 )

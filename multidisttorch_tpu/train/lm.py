@@ -149,6 +149,19 @@ def make_lm_train_step(
     repl, tokens_sh, state_sh = _lm_shardings(
         trial, sequence_parallel, shardings
     )
+    step_fn = _build_lm_step_fn(model, tx, aux_loss_weight)
+    return jax.jit(
+        step_fn,
+        in_shardings=(state_sh, tokens_sh),
+        out_shardings=(state_sh, repl),
+        donate_argnums=(0,),
+    )
+
+
+def _build_lm_step_fn(model, tx, aux_loss_weight):
+    """The un-jitted LM optimizer step shared by the single-dispatch and
+    scan-fused factories (one copy of the loss/update math, so the two
+    cannot drift)."""
 
     def step_fn(state: TrainState, tokens: jax.Array):
         def loss_fn(params):
@@ -168,9 +181,51 @@ def make_lm_train_step(
             {"loss": loss.astype(jnp.float32)},
         )
 
+    return step_fn
+
+
+def make_lm_multi_step(
+    trial: TrialMesh,
+    model: Any,
+    tx: optax.GradientTransformation,
+    *,
+    sequence_parallel: bool = False,
+    shardings: Any = None,
+    aux_loss_weight: float = 1e-2,
+) -> Callable[[TrainState, jax.Array], tuple[TrainState, dict]]:
+    """K chained LM optimizer steps in ONE dispatch, via ``lax.scan``.
+
+    The LM analog of :func:`train.steps.make_multi_step`, and for the
+    same reason (docs/DISPATCH.md): a single LM step at bench scale is
+    ~1 ms of device time on a v5e, the same order as one host enqueue,
+    so a step-per-dispatch loop leaves the chip idle half the time.
+    ``token_chunks`` is ``(K, B, T) int32`` — sharded over the submesh
+    data axis on B (plain DP) or T (``sequence_parallel``) — and
+    ``metrics['loss']`` comes back ``(K,)``, the same per-step logging
+    contract as the single-step factory. Per-step activations do not
+    accumulate across the scan (each iteration differentiates and
+    updates inside its own body).
+    """
+    repl, tokens_sh, state_sh = _lm_shardings(
+        trial, sequence_parallel, shardings
+    )
+    chunks_sh = trial.sharding(
+        *((None, None, DATA_AXIS) if sequence_parallel
+          else (None, DATA_AXIS, None))
+    )
+    step_fn = _build_lm_step_fn(model, tx, aux_loss_weight)
+
+    def multi_fn(state: TrainState, token_chunks: jax.Array):
+        def body(s, toks):
+            s, metrics = step_fn(s, toks)
+            return s, metrics["loss"]
+
+        state, losses = jax.lax.scan(body, state, token_chunks)
+        return state, {"loss": losses}
+
     return jax.jit(
-        step_fn,
-        in_shardings=(state_sh, tokens_sh),
+        multi_fn,
+        in_shardings=(state_sh, chunks_sh),
         out_shardings=(state_sh, repl),
         donate_argnums=(0,),
     )

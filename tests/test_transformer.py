@@ -54,6 +54,60 @@ def test_ring_lm_forward_matches_dense():
     )
 
 
+def test_lm_multi_step_matches_sequential_steps():
+    # The scan-fused LM dispatch (make_lm_multi_step — the bench's TPU
+    # timing path, docs/DISPATCH.md) must be a pure fusion: K chained
+    # steps in one program produce the same losses and params as K
+    # single-step dispatches. Checked for plain DP and for sequence
+    # parallelism (tokens sharded over T).
+    from multidisttorch_tpu.train.lm import make_lm_multi_step
+
+    for sp in (False, True):
+        (g,) = setup_groups(1)
+        model = TransformerLM(**_COMMON)
+        tx = optax.adam(1e-3)
+        tokens = np.random.default_rng(7).integers(
+            0, VOCAB, (3, 8, 32), dtype=np.int32
+        )
+        tok_sh = (
+            g.sharding(None, DATA_AXIS) if sp else g.batch_sharding
+        )
+
+        state_a = create_lm_state(
+            g, model, tx, jax.random.key(0), example_len=32
+        )
+        step = make_lm_train_step(g, model, tx, sequence_parallel=sp)
+        seq_losses = []
+        for i in range(3):
+            state_a, m = step(
+                state_a, jax.device_put(jnp.asarray(tokens[i]), tok_sh)
+            )
+            seq_losses.append(float(m["loss"]))
+
+        state_b = create_lm_state(
+            g, model, tx, jax.random.key(0), example_len=32
+        )
+        multi = make_lm_multi_step(g, model, tx, sequence_parallel=sp)
+        chunks = jax.device_put(
+            jnp.asarray(tokens),
+            g.sharding(*((None, None, DATA_AXIS) if sp
+                         else (None, DATA_AXIS, None))),
+        )
+        state_b, m = multi(state_b, chunks)
+        assert m["loss"].shape == (3,)
+        assert int(state_b.step) == int(state_a.step) == 3
+        np.testing.assert_allclose(
+            np.asarray(m["loss"]), seq_losses, rtol=1e-5, atol=1e-6
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            jax.device_get(state_b.params),
+            jax.device_get(state_a.params),
+        )
+
+
 def test_ring_lm_grads_match_dense():
     (g,) = setup_groups(1)
     dense, ring = _models(g)

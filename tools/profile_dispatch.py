@@ -22,8 +22,10 @@ Protocol, per concurrency level N (1, 2, 4, 8):
 Reported per N: mean/p99 per-dispatch host cost, aggregate dispatch
 seconds, wall-clock, and dispatch share of wall — if the dispatch share
 approaches 1, the host loop (not the devices) caps trial concurrency.
-Set ``--trace DIR`` to wrap one timed round in ``jax.profiler.trace``
-for timeline evidence (TensorBoard/Perfetto).
+Set ``--trace DIR`` to wrap the LARGEST level's whole timed region in
+``jax.profiler.trace`` for timeline evidence (TensorBoard/Perfetto) —
+tracing perturbs that level's numbers, so take clean measurements from
+a separate untraced pass.
 
 CPU caveat, stated on the artifact: virtual CPU devices run the actual
 math on the same host cores, so ``wall_s`` mixes compute contention
