@@ -56,6 +56,11 @@ MEASURE_REPEATS = 5  # timed passes per number; report the median. The
 # pass is ~128k samples, so the extra passes cost well under a second.
 TORCH_MEASURE_STEPS = 30
 
+
+def _chunk_steps() -> int:
+    """Backend-resolved scan chunk (one policy for every bench mode)."""
+    return CHUNK_STEPS_TPU if jax.default_backend() == "tpu" else CHUNK_STEPS
+
 PREFLIGHT_TIMEOUT_S = 120  # first TPU init is ~20-40s healthy; a wedged
 # plugin blocks forever (round 1: rc=124 after 9 min; rounds 2-4: every
 # probe blocked >150s) — cap it well past healthy-init time. The whole
@@ -370,9 +375,10 @@ def _flagship_setup(num_groups: int = 1):
 
 
 def _timed_chunks(trial, model, tx, **step_kwargs) -> tuple[float, list]:
-    """The one measurement protocol: scan-fused dispatch (CHUNK_STEPS
-    optimizer updates per host round-trip — the TPU-idiomatic shape of
-    the reference's per-batch loop, vae-hpo.py:67-74), one warmup
+    """The one measurement protocol: scan-fused dispatch (a
+    backend-sized chunk of optimizer updates per host round-trip —
+    ``_chunk_steps()`` — the TPU-idiomatic shape of the reference's
+    per-batch loop, vae-hpo.py:67-74), one warmup
     compile, then MEASURE_REPEATS passes of MEASURE_CHUNKS timed chunks.
     Returns ``(median, per_pass_rates)`` in samples/sec (whole submesh) —
     the tunnel to the chip has ~2x run-to-run variance, so single-pass
@@ -384,19 +390,16 @@ def _timed_chunks(trial, model, tx, **step_kwargs) -> tuple[float, list]:
     from multidisttorch_tpu.train.steps import create_train_state, make_multi_step
     from multidisttorch_tpu.utils.profiling import profile_trace
 
-    chunk = (
-        CHUNK_STEPS_TPU if jax.default_backend() == "tpu" else CHUNK_STEPS
-    )
+    chunk = _chunk_steps()
     state = create_train_state(trial, model, tx, jax.random.key(0))
     multi = make_multi_step(trial, model, tx, **step_kwargs)
-    batches = jax.device_put(
-        jnp.asarray(
-            np.random.default_rng(0)
-            .uniform(0, 1, (chunk, BATCH, 784))
-            .astype(np.float32)
-        ),
-        trial.sharding(None, "data"),
-    )
+    # Synthetic batches generated ON DEVICE, directly into the data
+    # sharding: at the TPU chunk size this is 401 MB that would
+    # otherwise cross the (slow, intermittent) tunnel per timed mode.
+    batches = jax.jit(
+        lambda k: jax.random.uniform(k, (chunk, BATCH, 784), jnp.float32),
+        out_shardings=trial.sharding(None, "data"),
+    )(jax.random.key(0))
     key = jax.random.key(1)
     state, _ = multi(state, batches, key)  # compile + warmup
     jax.block_until_ready(state.params)
@@ -439,6 +442,10 @@ def bench_ours() -> dict:
         "p10": round(float(np.percentile(per_chip, 10)), 1),
         "p90": round(float(np.percentile(per_chip, 90)), 1),
         "passes": len(per_chip),
+        # Measurement shape provenance: the chunk became
+        # backend-dependent in r5, so cross-round artifact comparisons
+        # need the value recorded next to the number it produced.
+        "chunk_steps": _chunk_steps(),
     }
 
 
@@ -878,20 +885,20 @@ def bench_concurrency(num_trials: int) -> dict:
     # Same TPU chunk sizing as the flagship timing (docs/DISPATCH.md):
     # 100-step chunks on real chips would make this measure the host
     # loop, not per-trial chip efficiency.
-    chunk = (
-        CHUNK_STEPS_TPU if jax.default_backend() == "tpu" else CHUNK_STEPS
-    )
-    batches_np = np.random.default_rng(0).uniform(
-        0, 1, (chunk, BATCH, 784)
-    ).astype(np.float32)
+    chunk = _chunk_steps()
     key = jax.random.key(1)
 
     def setup_trial(g):
         state = create_train_state(g, model, tx, jax.random.key(g.group_id))
         step = make_multi_step(g, model, tx)
-        batches = jax.device_put(
-            jnp.asarray(batches_np), g.sharding(None, "data")
-        )
+        # On-device generation straight into each trial's submesh
+        # sharding (same no-tunnel-transfer rationale as _timed_chunks).
+        batches = jax.jit(
+            lambda k: jax.random.uniform(
+                k, (chunk, BATCH, 784), jnp.float32
+            ),
+            out_shardings=g.sharding(None, "data"),
+        )(jax.random.key(0))
         return {"state": state, "step": step, "batches": batches}
 
     trials = [setup_trial(g) for g in groups]
@@ -927,6 +934,7 @@ def bench_concurrency(num_trials: int) -> dict:
     ndev = len(jax.devices())
     out = {
         "num_trials": num_trials,
+        "chunk_steps": chunk,  # measurement-shape provenance (r5)
         "alone_samples_per_sec": round(alone_sps, 1),
         "concurrent_per_trial_samples_per_sec": round(per_trial_sps, 1),
         "aggregate_samples_per_sec": round(per_trial_sps * num_trials, 1),

@@ -116,15 +116,20 @@ def lm_loss_mean(logits: jax.Array, tokens: jax.Array) -> jax.Array:
 
 def _lm_shardings(trial: TrialMesh, sequence_parallel: bool, shardings):
     """The one copy of the LM input/state sharding contract shared by
-    the train and eval step builders: tokens shard T over the data axis
-    under sequence parallelism (batch replicated), else B (plain DP)."""
+    the train, eval, and scan-fused step builders: ``(B, T)`` tokens
+    shard T over the data axis under sequence parallelism (batch
+    replicated), else B (plain DP). ``(K, B, T)`` stacked chunks are
+    the same contract with a leading unsharded scan axis — derived
+    here from the tokens spec so the two can never drift."""
     repl = trial.replicated_sharding
     tokens_sh = (
         trial.sharding(None, DATA_AXIS)
         if sequence_parallel
         else trial.batch_sharding
     )
-    return repl, tokens_sh, (repl if shardings is None else shardings)
+    spec = tuple(tokens_sh.spec) + (None,) * (2 - len(tokens_sh.spec))
+    chunks_sh = trial.sharding(None, *spec)
+    return repl, tokens_sh, chunks_sh, (repl if shardings is None else shardings)
 
 
 def make_lm_train_step(
@@ -146,7 +151,7 @@ def make_lm_train_step(
     nothing). A model returning ``(logits, aux)`` (the MoE LM's Switch
     load-balancing term) trains on
     ``lm_loss + aux_loss_weight * aux``."""
-    repl, tokens_sh, state_sh = _lm_shardings(
+    repl, tokens_sh, _, state_sh = _lm_shardings(
         trial, sequence_parallel, shardings
     )
     step_fn = _build_lm_step_fn(model, tx, aux_loss_weight)
@@ -206,12 +211,8 @@ def make_lm_multi_step(
     accumulate across the scan (each iteration differentiates and
     updates inside its own body).
     """
-    repl, tokens_sh, state_sh = _lm_shardings(
+    repl, _, chunks_sh, state_sh = _lm_shardings(
         trial, sequence_parallel, shardings
-    )
-    chunks_sh = trial.sharding(
-        *((None, None, DATA_AXIS) if sequence_parallel
-          else (None, DATA_AXIS, None))
     )
     step_fn = _build_lm_step_fn(model, tx, aux_loss_weight)
 
@@ -241,7 +242,7 @@ def make_lm_eval_step(
     """``eval(state, tokens) -> {loss, perplexity}`` — same next-token
     objective and token sharding contract as :func:`make_lm_train_step`,
     no gradient."""
-    repl, tokens_sh, state_sh = _lm_shardings(
+    repl, tokens_sh, _, state_sh = _lm_shardings(
         trial, sequence_parallel, shardings
     )
 
