@@ -524,7 +524,11 @@ def bench_lm() -> dict:
     from multidisttorch_tpu.models.transformer import TransformerLM
     from multidisttorch_tpu.ops.pallas_attention import make_flash_attention
     from multidisttorch_tpu.parallel.mesh import setup_groups
-    from multidisttorch_tpu.train.lm import create_lm_state, make_lm_multi_step
+    from multidisttorch_tpu.train.lm import (
+        create_lm_state,
+        lm_chunk_sharding,
+        make_lm_multi_step,
+    )
 
     (trial,) = setup_groups(1)
     on_tpu = jax.default_backend() == "tpu"
@@ -538,7 +542,7 @@ def bench_lm() -> dict:
                 0, LM_VOCAB, (LM_STEPS, LM_BATCH, LM_SEQ), dtype=np.int32
             )
         ),
-        trial.sharding(None, "data", None),
+        lm_chunk_sharding(trial),
     )
 
     def timed(attention) -> tuple[float, list, float]:

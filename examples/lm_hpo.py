@@ -38,7 +38,9 @@ from multidisttorch_tpu.ops.ring_attention import make_ring_attention  # noqa: E
 from multidisttorch_tpu.parallel.mesh import DATA_AXIS  # noqa: E402
 from multidisttorch_tpu.train.lm import (  # noqa: E402
     create_lm_state,
+    lm_chunk_sharding,
     make_lm_eval_step,
+    make_lm_multi_step,
     make_lm_train_step,
 )
 
@@ -54,6 +56,13 @@ def main():
     parser.add_argument("--d-model", type=int, default=64)
     parser.add_argument("--layers", type=int, default=2)
     parser.add_argument("--steps", type=int, default=40)
+    parser.add_argument(
+        "--fused-steps", type=int, default=1, metavar="K",
+        help="optimizer steps per device dispatch (make_lm_multi_step's "
+        "lax.scan). 1 = a dispatch per step; larger K amortizes the "
+        "host enqueue that otherwise caps concurrent trials "
+        "(docs/DISPATCH.md sizing rule). Must divide --steps.",
+    )
     parser.add_argument(
         "--ring-flash", action="store_true",
         help="flash-kernel hops (ops/pallas_attention.py) inside each "
@@ -72,6 +81,11 @@ def main():
         "(expert parallelism) while the context rides the ring",
     )
     args = parser.parse_args()
+    if args.fused_steps < 1 or args.steps % args.fused_steps:
+        parser.error(
+            f"--fused-steps {args.fused_steps} must be >= 1 and divide "
+            f"--steps {args.steps}"
+        )
 
     mdt.initialize_runtime()
     if args.model_parallel > 1:
@@ -159,26 +173,39 @@ def main():
         )
         if psh is not None:
             sh = state_shardings(state)
-        trials.append(
-            {
-                "trial": g,
-                "lr": lr,
-                "state": state,
-                "step": make_lm_train_step(
-                    g, model, tx, sequence_parallel=True, shardings=sh
+        entry = {
+            "trial": g,
+            "lr": lr,
+            "state": state,
+            "eval": make_lm_eval_step(
+                g, model, sequence_parallel=True, shardings=sh
+            ),
+            # g.device_put (not jax.device_put): on a process-
+            # spanning submesh each owner feeds only its
+            # addressable shards
+            "tokens": g.device_put(
+                rows,
+                g.sharding(None, DATA_AXIS),
+            ),
+        }
+        if args.fused_steps > 1:
+            # Production dispatch shape: K steps per host round-trip
+            # (the sizing rule from docs/DISPATCH.md). The demo trains
+            # on one fixed batch, so the stacked chunk just repeats it.
+            entry["step"] = make_lm_multi_step(
+                g, model, tx, sequence_parallel=True, shardings=sh
+            )
+            entry["chunks"] = g.device_put(
+                np.ascontiguousarray(
+                    np.broadcast_to(rows, (args.fused_steps,) + rows.shape)
                 ),
-                "eval": make_lm_eval_step(
-                    g, model, sequence_parallel=True, shardings=sh
-                ),
-                # g.device_put (not jax.device_put): on a process-
-                # spanning submesh each owner feeds only its
-                # addressable shards
-                "tokens": g.device_put(
-                    rows,
-                    g.sharding(None, DATA_AXIS),
-                ),
-            }
-        )
+                lm_chunk_sharding(g, sequence_parallel=True),
+            )
+        else:
+            entry["step"] = make_lm_train_step(
+                g, model, tx, sequence_parallel=True, shardings=sh
+            )
+        trials.append(entry)
 
     kind = "ring-flash" if args.ring_flash else "ring"
     per_dev = args.seq_len // groups[0].data_size
@@ -195,15 +222,29 @@ def main():
         f"ring){tp}"
     )
 
-    # Cooperative round-robin: one step per trial per cycle, no barriers.
+    # Cooperative round-robin: one dispatch per trial per cycle (K
+    # fused steps each under --fused-steps), no barriers.
     t0 = time.time()
-    for i in range(args.steps):
+    K = args.fused_steps
+    interval = 10
+    for i in range(args.steps // K):
         for t in trials:
-            t["state"], t["m"] = t["step"](t["state"], t["tokens"])
-        if i % 10 == 0:
+            t["state"], t["m"] = t["step"](
+                t["state"], t["chunks"] if K > 1 else t["tokens"]
+            )
+        # Log the loss of the exact step a per-step loop would have
+        # logged, labeled with that step (the fused metrics come back
+        # (K,), so the step is indexable — same cadence contract as
+        # hpo/driver.py's fused logging).
+        first = i * K
+        j = -(-first // interval) * interval  # ceil to the cadence
+        if j < first + K:
             for t in trials:
+                loss = (
+                    t["m"]["loss"] if K == 1 else t["m"]["loss"][j - first]
+                )
                 mdt.log0(
-                    f"step {i:4d}  loss {float(t['m']['loss']):.4f}",
+                    f"step {j:4d}  loss {float(loss):.4f}",
                     trial=t["trial"],
                 )
 

@@ -132,6 +132,18 @@ def _lm_shardings(trial: TrialMesh, sequence_parallel: bool, shardings):
     return repl, tokens_sh, chunks_sh, (repl if shardings is None else shardings)
 
 
+def lm_chunk_sharding(trial: TrialMesh, *, sequence_parallel: bool = False):
+    """Placement helper for ``make_lm_multi_step`` inputs: the
+    ``(K, B, T)`` stacked-chunk ``NamedSharding`` (leading scan axis
+    unsharded; B or T over the data axis per the tokens contract).
+    Callers should ``device_put`` chunks with THIS rather than
+    restating the spec — it is derived from the same ``_lm_shardings``
+    source as the step builders, so placement can't drift from what
+    the jitted program expects (which would trigger a resharding copy
+    on every dispatch)."""
+    return _lm_shardings(trial, sequence_parallel, None)[2]
+
+
 def make_lm_train_step(
     trial: TrialMesh,
     model: Any,

@@ -62,6 +62,15 @@ def test_lm_hpo_example():
 
 
 @pytest.mark.examples
+def test_lm_hpo_example_fused_dispatch():
+    # The production dispatch shape (docs/DISPATCH.md): K fused steps
+    # per device round-trip via make_lm_multi_step.
+    out = _run(["lm_hpo.py", "--ngroups", "2", "--seq-len", "64",
+                "--steps", "12", "--fused-steps", "4"])
+    assert out.count("perplexity") == 2
+
+
+@pytest.mark.examples
 def test_lm_long_context_example():
     out = _run(["lm_long_context.py", "--seq-len", "64", "--steps", "8"])
     assert "greedy decode matches" in out
