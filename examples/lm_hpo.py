@@ -195,7 +195,7 @@ def main():
             entry["step"] = make_lm_multi_step(
                 g, model, tx, sequence_parallel=True, shardings=sh
             )
-            entry["chunks"] = g.device_put(
+            entry["input"] = g.device_put(
                 np.ascontiguousarray(
                     np.broadcast_to(rows, (args.fused_steps,) + rows.shape)
                 ),
@@ -205,6 +205,7 @@ def main():
             entry["step"] = make_lm_train_step(
                 g, model, tx, sequence_parallel=True, shardings=sh
             )
+            entry["input"] = entry["tokens"]
         trials.append(entry)
 
     kind = "ring-flash" if args.ring_flash else "ring"
@@ -229,16 +230,14 @@ def main():
     interval = 10
     for i in range(args.steps // K):
         for t in trials:
-            t["state"], t["m"] = t["step"](
-                t["state"], t["chunks"] if K > 1 else t["tokens"]
-            )
-        # Log the loss of the exact step a per-step loop would have
-        # logged, labeled with that step (the fused metrics come back
-        # (K,), so the step is indexable — same cadence contract as
-        # hpo/driver.py's fused logging).
+            t["state"], t["m"] = t["step"](t["state"], t["input"])
+        # Log the loss of EVERY step a per-step loop would have logged
+        # in this chunk, labeled with that step (the fused metrics come
+        # back (K,), so each cadence point is indexable — same contract
+        # as hpo/driver.py's fused logging, incl. K > interval).
         first = i * K
         j = -(-first // interval) * interval  # ceil to the cadence
-        if j < first + K:
+        while j < first + K:
             for t in trials:
                 loss = (
                     t["m"]["loss"] if K == 1 else t["m"]["loss"][j - first]
@@ -247,6 +246,7 @@ def main():
                     f"step {j:4d}  loss {float(loss):.4f}",
                     trial=t["trial"],
                 )
+            j += interval
 
     for t in trials:
         ev = t["eval"](t["state"], t["tokens"])

@@ -1,7 +1,7 @@
 from multidisttorch_tpu.train.lm import (
     create_lm_state,
-    lm_loss_mean,
     lm_chunk_sharding,
+    lm_loss_mean,
     make_lm_eval_step,
     make_lm_multi_step,
     make_lm_sample,
