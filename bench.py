@@ -1242,6 +1242,18 @@ def main():
     # wedged-TPU machine blocks forever without the probe + CPU fallback.
     backend = _ensure_backend()
 
+    if backend.get("platform") == "cpu":
+        # Persistent XLA compile cache for the CPU fallback (shared
+        # policy + dir resolution: utils/compile_cache.py) — repeated
+        # suite retries against the wedged chip shouldn't pay full CPU
+        # compiles every hour. Deliberately NOT enabled on TPU: the
+        # rare chip window gets the exact, known-good compile path.
+        from multidisttorch_tpu.utils.compile_cache import (
+            enable_persistent_compile_cache,
+        )
+
+        enable_persistent_compile_cache()
+
     if args.suite:
         # Chip windows are rare and close without warning, and a wedged
         # tunnel HANGS rather than raising — so on TPU the suite banks
