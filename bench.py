@@ -20,6 +20,7 @@ import subprocess
 import sys
 import time
 import warnings
+from functools import partial
 
 warnings.filterwarnings("ignore")
 
@@ -758,11 +759,11 @@ def bench_kernel_smoke() -> dict:
 
         check(f"fused_elbo_{dt_name}", elbo_case)
 
-        def flash_case(dt=dt, tol=tol):
-            # T=256 → the tiled 128-block grid path, fwd and bwd.
+        def flash_case(dt=dt, tol=tol, shape=(1, 256, 2, 64)):
+            # Default shape: T=256 → the tiled 128-block grid path,
+            # fwd and bwd. One body serves every flash smoke variant.
             q, k, v = (
-                jnp.asarray(rng.normal(size=(1, 256, 2, 64)), dt)
-                for _ in range(3)
+                jnp.asarray(rng.normal(size=shape), dt) for _ in range(3)
             )
 
             def run(attn):
@@ -781,6 +782,15 @@ def bench_kernel_smoke() -> dict:
                 rel_close(a.astype(jnp.float32), b.astype(jnp.float32), tol)
 
         check(f"flash_attention_{dt_name}", flash_case)
+
+    # The causal pad-to-tile path for large non-128-divisible T (new in
+    # r5): T=1300 pads to 1408 and must stay exact against the dense
+    # reference, fwd and bwd. f32 only — one compile's worth of
+    # hardware proof for the pad path's grid shape.
+    check(
+        "flash_attention_pad_f32",
+        partial(flash_case, jnp.float32, 2e-4, shape=(1, 1300, 1, 32)),
+    )
     return out
 
 

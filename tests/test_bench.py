@@ -112,7 +112,8 @@ def test_kernel_smoke_all_pass():
     r = bench.bench_kernel_smoke()
     assert r["platform"] == "cpu"
     for name in ("fused_elbo_f32", "fused_elbo_bf16",
-                 "flash_attention_f32", "flash_attention_bf16"):
+                 "flash_attention_f32", "flash_attention_bf16",
+                 "flash_attention_pad_f32"):
         assert r[name]["ok"], f"{name}: {r[name].get('error')}"
 
 
