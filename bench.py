@@ -729,6 +729,26 @@ def bench_kernel_smoke() -> dict:
             # would bank a false hardware proof (NaN err also lands here)
             raise ValueError(f"kernel mismatch: rel err {err:.3e} > {tol}")
 
+    def flash_case(dt, tol, shape=(1, 256, 2, 64)):
+        # Default shape: T=256 → the tiled 128-block grid path, fwd and
+        # bwd. One body serves every flash smoke variant.
+        q, k, v = (
+            jnp.asarray(rng.normal(size=shape), dt) for _ in range(3)
+        )
+
+        def run(attn):
+            f = lambda q, k, v: jnp.sum(
+                attn(q, k, v, causal=True).astype(jnp.float32) ** 2
+            )
+            return jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))(q, k, v)
+
+        (got, g_got), (want, g_want) = run(flash_attention), run(
+            dense_attention_reference
+        )
+        rel_close(got, want, tol)
+        for a, b in zip(g_got, g_want):
+            rel_close(a.astype(jnp.float32), b.astype(jnp.float32), tol)
+
     for dt_name, dt in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
         # bf16 operands round at ~2^-8; sums over hundreds of terms in a
         # shared-f32 accumulation still differ per-path at that scale.
@@ -759,29 +779,7 @@ def bench_kernel_smoke() -> dict:
 
         check(f"fused_elbo_{dt_name}", elbo_case)
 
-        def flash_case(dt=dt, tol=tol, shape=(1, 256, 2, 64)):
-            # Default shape: T=256 → the tiled 128-block grid path,
-            # fwd and bwd. One body serves every flash smoke variant.
-            q, k, v = (
-                jnp.asarray(rng.normal(size=shape), dt) for _ in range(3)
-            )
-
-            def run(attn):
-                f = lambda q, k, v: jnp.sum(
-                    attn(q, k, v, causal=True).astype(jnp.float32) ** 2
-                )
-                return jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))(
-                    q, k, v
-                )
-
-            (got, g_got), (want, g_want) = run(flash_attention), run(
-                dense_attention_reference
-            )
-            rel_close(got, want, tol)
-            for a, b in zip(g_got, g_want):
-                rel_close(a.astype(jnp.float32), b.astype(jnp.float32), tol)
-
-        check(f"flash_attention_{dt_name}", flash_case)
+        check(f"flash_attention_{dt_name}", partial(flash_case, dt, tol))
 
     # The causal pad-to-tile path for large non-128-divisible T (new in
     # r5): T=1300 pads to 1408 and must stay exact against the dense

@@ -1,0 +1,53 @@
+"""The shared persistent-compile-cache switch (utils/compile_cache.py)
+— the one policy behind the test harness, the multichip dryrun, and
+bench's CPU fallback."""
+
+import os
+
+import jax
+
+from multidisttorch_tpu.utils.compile_cache import (
+    default_cache_dir,
+    enable_persistent_compile_cache,
+)
+
+
+def test_default_dir_honors_env_override(monkeypatch):
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/some/shared/disk")
+    assert default_cache_dir() == "/some/shared/disk"
+
+
+def test_default_dir_anchors_at_checkout_root(monkeypatch):
+    # cwd-independent: the fallback is .jax_cache NEXT TO the package,
+    # so every entry point shares one cache no matter where it runs.
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    monkeypatch.chdir("/tmp")
+    d = default_cache_dir()
+    assert d.endswith(".jax_cache")
+    import multidisttorch_tpu
+
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(multidisttorch_tpu.__file__))
+    )
+    assert d == os.path.join(pkg_root, ".jax_cache")
+
+
+def test_enable_sets_config_and_creates_dir(tmp_path):
+    target = str(tmp_path / "cache")
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        assert enable_persistent_compile_cache(target) is True
+        assert os.path.isdir(target)
+        assert jax.config.jax_compilation_cache_dir == target
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_enable_is_best_effort_on_bad_dir(tmp_path):
+    # A path that cannot be a directory must return False and leave the
+    # config untouched — the cache is an optimization, never a failure.
+    blocker = tmp_path / "file"
+    blocker.write_text("x")
+    prev = jax.config.jax_compilation_cache_dir
+    assert enable_persistent_compile_cache(str(blocker / "sub")) is False
+    assert jax.config.jax_compilation_cache_dir == prev
