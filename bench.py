@@ -475,6 +475,149 @@ def bench_fused_loss_comparison() -> dict:
     return out
 
 
+# Stacked-trial bench shape: a fixed pool of 8 pending flagship trials
+# (the stacking precondition — trials outnumber groups), run at K lanes
+# per single-device group through the vmapped stacked step
+# (train.steps.make_stacked_train_step), per-step dispatch (chunk 1 —
+# the loop shape where small-trial sweeps are host-bound,
+# docs/DISPATCH.md: blocked share 0.85-0.98). K=1 is today's
+# one-trial-per-group path; higher K packs the same trials onto fewer
+# chips, one dispatch advancing K trials. The headline is
+# samples/sec per OCCUPIED chip: the consolidation win — the same sweep
+# on 1/K of the chips (equivalently, K sweeps on the same chips) — is
+# exactly what stacking buys, and per-occupied-chip throughput is the
+# number that states it without crediting idle hardware.
+STACKED_TRIALS = 8
+STACKED_MEASURE_STEPS = 100  # optimizer steps per trial per timed pass
+STACKED_REPEATS = 3
+STACKED_LEVELS = (1, 2, 4, 8)
+
+
+def bench_stacked() -> dict:
+    """Per-occupied-chip throughput of 8 flagship trials at K lanes/group.
+
+    The artifact the trial-stacking mode is judged by (ISSUE 1
+    acceptance: >= 1.5x samples/sec/chip at K=4 vs K=1 on the CPU
+    fallback): same 8 trials, same per-trial batch, same model — only
+    the lanes-per-group packing varies. ``dispatches_per_trial_step``
+    (1/K) states the mechanism next to the outcome. On the CPU fallback
+    the groups are virtual single-CPU devices (the same harness
+    topology as bench_concurrency and docs/DISPATCH.md), and the same
+    caveat applies: virtual chips share host cores, so the ratio is a
+    methodology proof of the packing win, not a hardware number — the
+    real-chip rerun banks itself through the suite when a TPU window
+    opens.
+    """
+    from multidisttorch_tpu.parallel.mesh import setup_groups
+    from multidisttorch_tpu.train.steps import (
+        TrialHypers,
+        create_stacked_train_state,
+        make_stacked_train_step,
+    )
+
+    ndev = len(jax.devices())
+    dtype = jnp.bfloat16 if jax.default_backend() == "tpu" else jnp.float32
+    from multidisttorch_tpu.models.vae import VAE
+
+    model = VAE(hidden_dim=HIDDEN, latent_dim=LATENT, dtype=dtype)
+    all_groups = setup_groups(ndev)  # single-device groups
+    out = {
+        "trials": STACKED_TRIALS,
+        "chunk_steps": 1,
+        "measure_steps": STACKED_MEASURE_STEPS,
+        "n_devices": ndev,
+        "levels": [],
+    }
+    if jax.default_backend() == "cpu":
+        out["cpu_caveat"] = (
+            "virtual CPU devices share host cores: per-occupied-chip "
+            "ratios prove the packing methodology, not real-chip "
+            "throughput (same caveat as bench --concurrency)"
+        )
+    rates = {}
+    for k in [lv for lv in STACKED_LEVELS if lv <= STACKED_TRIALS]:
+        buckets = STACKED_TRIALS // k
+        chips_used = min(ndev, buckets)
+        units = []
+        for b in range(buckets):
+            g = all_groups[b % chips_used]
+            step = make_stacked_train_step(g, model)
+            state = create_stacked_train_state(g, model, list(range(k)))
+            base_rngs = jnp.stack(
+                [jax.random.key(s + 1) for s in range(k)]
+            )
+            batch = jax.jit(
+                lambda key, k=k, g=g: jax.random.uniform(
+                    key, (k, BATCH, 784), jnp.float32
+                ),
+                out_shardings=g.sharding(None, "data"),
+            )(jax.random.key(0))
+            units.append(
+                {
+                    "step": step,
+                    "state": state,
+                    "base": base_rngs,
+                    "batch": batch,
+                    "hypers": TrialHypers.stack([1e-3] * k, [1.0] * k),
+                }
+            )
+        lane_steps = [
+            jnp.full((k,), i, jnp.int32)
+            for i in range(STACKED_MEASURE_STEPS)
+        ]
+        for u in units:  # compile + warmup every unit
+            u["state"], _ = u["step"](
+                u["state"], u["hypers"], u["batch"], u["base"], lane_steps[0]
+            )
+        for u in units:
+            jax.block_until_ready(u["state"].params)
+        pass_rates = []
+        for _ in range(STACKED_REPEATS):
+            t0 = time.perf_counter()
+            for i in range(STACKED_MEASURE_STEPS):
+                for u in units:  # the driver's round-robin dispatch shape
+                    u["state"], _ = u["step"](
+                        u["state"], u["hypers"], u["batch"], u["base"],
+                        lane_steps[i],
+                    )
+            for u in units:
+                jax.block_until_ready(u["state"].params)
+            dt = time.perf_counter() - t0
+            agg = STACKED_MEASURE_STEPS * STACKED_TRIALS * BATCH / dt
+            pass_rates.append(agg / chips_used)
+        med = float(np.median(pass_rates))
+        rates[k] = med
+        out["levels"].append(
+            {
+                "k": k,
+                "buckets": buckets,
+                "chips_used": chips_used,
+                "samples_per_sec_per_chip": round(med, 1),
+                "pass_rates": [round(r, 1) for r in pass_rates],
+                "dispatches_per_trial_step": round(1.0 / k, 4),
+            }
+        )
+    for lvl in out["levels"]:
+        lvl["speedup_vs_k1"] = round(rates[lvl["k"]] / rates[1], 3)
+    out["k4_vs_k1"] = (
+        round(rates[4] / rates[1], 3) if 4 in rates and 1 in rates else None
+    )
+    if any(lvl["chips_used"] < lvl["buckets"] for lvl in out["levels"]):
+        # Fewer devices than buckets (e.g. the suite on a 1-chip TPU or
+        # un-flagged CPU): buckets time-share chips, so per-occupied-
+        # chip ratios no longer isolate the packing win the protocol
+        # documents — say so in the artifact instead of leaving a
+        # degenerate number that reads like a real one.
+        out["packing_limited"] = True
+        out["packing_note"] = (
+            "buckets exceed devices at some K: levels time-share chips "
+            "and speedup_vs_k1 is NOT the per-occupied-chip packing "
+            "ratio of docs/STACKING.md (run via `bench.py --stacked`, "
+            "which forces the 8-virtual-device topology on CPU)"
+        )
+    return out
+
+
 # LM bench shape: sized so one TPU v5e chip (16 GB HBM) is comfortably
 # matmul-dominated — the MFU story the tiny flagship VAE cannot tell
 # (its 784x400 matmuls are dispatch/bandwidth-bound by construction).
@@ -824,6 +967,10 @@ def bench_suite(checkpoint=None) -> dict:
          else (lambda: {"skipped": "full-size decode needs the TPU"})),
         ("to_elbo_150", lambda: bench_to_elbo(150.0)),
         ("loader", bench_loader),
+        # Trial-stacking artifact (ISSUE 1): K trials per dispatch vs
+        # one — cheap on any backend, and the stacked mode's win must be
+        # banked from real chips too when a window opens.
+        ("stacked", bench_stacked),
     ):
         t0 = time.perf_counter()
         try:
@@ -1136,6 +1283,109 @@ def bench_to_elbo(target: float, max_steps: int = 20000) -> dict:
     }
 
 
+def _flagship_cpu_history(pattern: str = "BENCH_r*.json") -> list[dict]:
+    """Prior rounds' CPU-fallback flagship rates, each with the scan
+    chunk it was measured at.
+
+    The driver banks every round's bench stdout as ``BENCH_r{N}.json``
+    with the output's LAST bytes in ``tail`` — which means old rounds
+    parse as a clean JSON line while long-output rounds arrive
+    front-truncated (r05). Two extraction paths, strictest first: parse
+    a complete JSON line (platform must be cpu), else regex the flat
+    ``flagship_passes`` object out of the truncated tail (guarded by
+    the cpu device marker; the embedded stale-TPU payload carries no
+    flagship_passes, so it cannot be mistaken for the headline).
+    Rounds before the chunk-provenance field measured at the then-
+    constant chunk 100.
+    """
+    import glob
+    import re
+
+    out = []
+    for p in sorted(glob.glob(pattern)):
+        try:
+            with open(p) as f:
+                tail = json.load(f).get("tail") or ""
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            continue
+        rec = None
+        for line in tail.strip().splitlines():
+            if not line.startswith("{"):
+                continue
+            try:
+                j = json.loads(line)
+            except ValueError:
+                continue
+            det = j.get("detail") or {}
+            if not isinstance(det, dict) or det.get("platform") != "cpu":
+                continue
+            fp = det.get("flagship_passes") or {}
+            # Top-level `value` is only a flagship rate on the flagship
+            # metric line — other modes (--stacked, --to-elbo) also
+            # emit cpu-platform JSON whose value means something else
+            # entirely and must not pollute the drift history.
+            fallback = (
+                j.get("value")
+                if j.get("metric") == "vae_train_samples_per_sec_per_chip"
+                else None
+            )
+            if not fp.get("samples_per_sec_per_chip") and fallback is None:
+                continue
+            rec = {
+                "file": p,
+                "samples_per_sec_per_chip": fp.get(
+                    "samples_per_sec_per_chip", fallback
+                ),
+                "chunk_steps": fp.get("chunk_steps", 100),
+            }
+            break
+        if rec is None and '"device_kind": "cpu"' in tail:
+            m = re.search(r'"flagship_passes": ({[^{}]*})', tail)
+            if m:
+                try:
+                    fp = json.loads(m.group(1))
+                except ValueError:
+                    fp = {}
+                if fp.get("samples_per_sec_per_chip"):
+                    rec = {
+                        "file": p,
+                        "samples_per_sec_per_chip": fp[
+                            "samples_per_sec_per_chip"
+                        ],
+                        "chunk_steps": fp.get("chunk_steps", 100),
+                    }
+        if rec and rec["samples_per_sec_per_chip"]:
+            out.append(rec)
+    return out
+
+
+def _drift_vs_prev_rounds(
+    current: float, chunk_steps: int, history: list[dict]
+) -> dict | None:
+    """Cross-round drift check for the CPU-fallback flagship number.
+
+    Same-shape comparisons only (prior rounds keyed by ``chunk_steps``
+    — a chunk change IS a measurement change, not drift). Returns the
+    ``vs_prev_rounds`` block for the artifact, with
+    ``drift_exceeds_20pct`` set when the current rate moved more than
+    20% off the prior-round median — the machine got slower/faster, or
+    the program did, and either way the round's number shouldn't be
+    read as comparable without this flag.
+    """
+    same = [h for h in history if h["chunk_steps"] == chunk_steps]
+    if not same:
+        return None
+    prior = [float(h["samples_per_sec_per_chip"]) for h in same]
+    med = float(np.median(prior))
+    ratio = current / med if med > 0 else float("nan")
+    return {
+        "prior_rounds": same,
+        "median_prior": round(med, 1),
+        "ratio_to_median": round(ratio, 3),
+        "drift_exceeds_20pct": bool(abs(ratio - 1.0) > 0.20),
+    }
+
+
 def _last_tpu_artifact() -> dict | None:
     """Newest banked real-TPU artifact, for embedding (marked stale) in
     a CPU-fallback headline.
@@ -1232,6 +1482,12 @@ def main():
         "(tokens/sec/chip — the bandwidth-bound serving metric)",
     )
     parser.add_argument(
+        "--stacked", action="store_true",
+        help="measure K stacked trials per dispatch (K in {1,2,4,8}): "
+        "samples/sec/chip and dispatches per trial-step — the "
+        "trial-stacking mode's banked evidence",
+    )
+    parser.add_argument(
         "--suite", action="store_true",
         help="bank every measurement (flagship, fused-loss comparison, "
         "LM, to-elbo, loader) in one process — for one-shot windows on "
@@ -1241,9 +1497,25 @@ def main():
 
     if sum(x is not None and x is not False
            for x in (args.concurrency, args.to_elbo, args.loader,
-                     args.lm, args.suite, args.decode)) > 1:
+                     args.lm, args.suite, args.decode, args.stacked)) > 1:
         parser.error("--concurrency/--to-elbo/--loader/--lm/--decode/"
-                     "--suite are mutually exclusive")
+                     "--suite/--stacked are mutually exclusive")
+
+    if args.stacked and "xla_force_host_platform_device_count" not in (
+        os.environ.get("XLA_FLAGS", "")
+    ):
+        # The stacked protocol measures PACKING — 8 pending trials at K
+        # lanes per single-device group — so the CPU fallback needs
+        # multiple virtual devices (the same harness topology as
+        # bench --concurrency / docs/DISPATCH.md). XLA parses this flag
+        # at backend init, not at import, so setting it here (before
+        # _ensure_backend's first jax.devices()) is effective; it shapes
+        # only the host-platform client, so a real TPU's device count
+        # is untouched.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        )
 
     # Every mode goes through the preflight first: the train_loop loader
     # condition (and all training modes) touch jax.devices(), which on a
@@ -1376,6 +1648,27 @@ def main():
         )
         return
 
+    if args.stacked:
+        r = bench_stacked()
+        k4 = next(
+            (lvl for lvl in r["levels"] if lvl["k"] == 4), r["levels"][-1]
+        )
+        r.update(backend)
+        print(
+            json.dumps(
+                {
+                    "metric": "stacked_vae_samples_per_sec_per_chip",
+                    "value": k4["samples_per_sec_per_chip"],
+                    "unit": "samples/sec/chip",
+                    # the acceptance ratio: stacked K=4 over K=1, same
+                    # protocol, same hardware, same timed window count
+                    "vs_baseline": r["k4_vs_k1"],
+                    "detail": r,
+                }
+            )
+        )
+        return
+
     if args.to_elbo is not None:
         r = bench_to_elbo(args.to_elbo)
         r.update(backend)
@@ -1429,6 +1722,23 @@ def main():
     mfu = (ours * _train_flops_per_sample() / peak) if peak else None
     detail = dict(backend)
     detail["flagship_passes"] = flagship_stats
+    if backend.get("platform") == "cpu":
+        # Cross-round drift tracking: the CPU fallback is the one
+        # number every round can measure, so it doubles as the canary
+        # for environment drift (slower container, changed BLAS, ...).
+        drift = _drift_vs_prev_rounds(
+            ours, _chunk_steps(), _flagship_cpu_history()
+        )
+        if drift is not None:
+            detail["vs_prev_rounds"] = drift
+            if drift["drift_exceeds_20pct"]:
+                print(
+                    "WARNING: flagship CPU rate moved "
+                    f"{drift['ratio_to_median']}x vs prior-round median "
+                    f"{drift['median_prior']} — same-shape comparison, "
+                    "treat cross-round conclusions with care",
+                    file=sys.stderr,
+                )
     _embed_stale_tpu_evidence(detail, backend)
     if peak:
         detail["peak_flops_per_chip"] = peak

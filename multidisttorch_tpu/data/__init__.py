@@ -8,4 +8,8 @@ from multidisttorch_tpu.data.datasets import (
     synthetic_corpus,
     synthetic_mnist,
 )
-from multidisttorch_tpu.data.sampler import EvalDataIterator, TrialDataIterator
+from multidisttorch_tpu.data.sampler import (
+    EvalDataIterator,
+    StackedTrialDataIterator,
+    TrialDataIterator,
+)

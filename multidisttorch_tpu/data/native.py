@@ -158,3 +158,53 @@ class NativeBatchGatherer:
             self.close()
         except Exception:
             pass
+
+
+class StackedBatchGatherer:
+    """K-lane stacked gather on top of :class:`NativeBatchGatherer`.
+
+    The trial-stacking execution mode (``hpo/driver.py``,
+    ``docs/STACKING.md``) feeds ``[K, B, ...]`` batches — batch ``b`` of
+    every lane, concatenated. That is just the flat gatherer run over an
+    *interleaved* permutation (``lane 0's batch b rows, lane 1's, ...``)
+    with ``batch_size = K*B``, so the C++ prefetch thread assembles a
+    whole stacked step per call with no new native code. Lanes may sit
+    at different (seed, epoch) permutations — exactly the mask-and-refill
+    case where bucket members' streams desynchronize.
+    """
+
+    def __init__(self, images: np.ndarray):
+        self._flat = NativeBatchGatherer(images)
+        self._k = 0
+        self._batch = 0
+
+    def start_round(self, perms: np.ndarray, batch_size: int) -> int:
+        """Begin prefetching one lockstep round. ``perms`` is ``(K, N)``
+        — each lane's full epoch permutation — and every lane consumes
+        ``batch_size`` rows per stacked step. Returns the number of
+        stacked steps (``N // batch_size``)."""
+        perms = np.asarray(perms)
+        if perms.ndim != 2:
+            raise ValueError(f"perms must be (K, N), got {perms.shape}")
+        k, n = perms.shape
+        nb = n // batch_size
+        # (K, nb, B) -> (nb, K, B): step-major interleave, dropping each
+        # lane's incomplete tail (the train-path drop-tail contract).
+        interleaved = (
+            perms[:, : nb * batch_size]
+            .reshape(k, nb, batch_size)
+            .transpose(1, 0, 2)
+            .reshape(-1)
+        )
+        self._k, self._batch = k, batch_size
+        got = self._flat.start_epoch(interleaved, k * batch_size)
+        assert got == nb, f"stacked round sized {got} != expected {nb}"
+        return nb
+
+    def next_stacked(self) -> np.ndarray:
+        """One ``(K, B, D)`` stacked batch (prefetched off-thread)."""
+        rows, _ = self._flat.next_batch()
+        return rows.reshape(self._k, self._batch, -1)
+
+    def close(self):
+        self._flat.close()

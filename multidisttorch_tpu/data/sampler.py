@@ -28,6 +28,19 @@ from multidisttorch_tpu.data.datasets import Dataset
 from multidisttorch_tpu.parallel.mesh import TrialMesh
 
 
+def epoch_permutation(seed: int, epoch: int, indices: np.ndarray) -> np.ndarray:
+    """THE per-(seed, epoch) permutation recipe — the single copy.
+
+    Every data path that must agree byte-for-byte derives its order
+    here: the unstacked iterator's epochs, its host-side first-batch
+    view, and the stacked iterator's lockstep rounds. The stacked/
+    unstacked bit-parity contract (tests/test_stacking.py) is exactly
+    the statement that these never drift.
+    """
+    rng = np.random.default_rng(np.random.SeedSequence([seed, epoch]))
+    return rng.permutation(indices)
+
+
 class TrialDataIterator:
     """Per-trial epoch iterator yielding device-ready sharded batches.
 
@@ -116,10 +129,7 @@ class TrialDataIterator:
         batch production shared by :meth:`epoch` and
         :meth:`epoch_chunks`, so their permutations and batch boundaries
         can never drift apart."""
-        rng = np.random.default_rng(
-            np.random.SeedSequence([self.seed, epoch])
-        )
-        perm = rng.permutation(self._indices)
+        perm = epoch_permutation(self.seed, epoch, self._indices)
 
         if self._use_native:
             from multidisttorch_tpu.data.native import NativeBatchGatherer
@@ -154,10 +164,7 @@ class TrialDataIterator:
         and no epoch-wide gather (a direct slice, bypassing the native
         prefetcher, which would otherwise spin up a whole-epoch
         background gather for one batch)."""
-        rng = np.random.default_rng(
-            np.random.SeedSequence([self.seed, epoch])
-        )
-        perm = rng.permutation(self._indices)
+        perm = epoch_permutation(self.seed, epoch, self._indices)
         return self.dataset.images[perm[: self.batch_size]]
 
     def epoch(self, epoch: int) -> Iterator:
@@ -250,6 +257,154 @@ class TrialDataIterator:
     @property
     def samples_per_epoch(self) -> int:
         return self.num_batches * self.batch_size
+
+
+class StackedTrialDataIterator:
+    """K lockstep trial data streams, gathered ``[K, B, ...]`` per step.
+
+    The feed for the trial-stacking execution mode (``hpo/driver.py``
+    stacked buckets; ``train.steps.make_stacked_*_step``): lane ``k``
+    replays exactly the stream a :class:`TrialDataIterator` with
+    ``seed=seeds[k]`` would produce — the same per-(seed, epoch)
+    permutation, the same drop-tail batch boundaries — but all K lanes'
+    batch ``b`` rows arrive as ONE host-side fancy-index gather and ONE
+    device transfer per step (or per chunk), so the host cost of feeding
+    K trials is the cost of feeding one. Bit-parity with the unstacked
+    iterator is regression-tested (tests/test_stacking.py).
+
+    Lanes advance in lockstep rounds of ``num_batches`` steps (all lanes
+    share the dataset and batch size, so their epochs align to rounds);
+    :meth:`set_lane` rebinds a lane to a new seed mid-sweep — the data
+    half of mask-and-refill retirement (the refilled lane starts its own
+    epoch 1 while neighbors continue wherever they are).
+
+    When the native C++ gatherer is available the interleaved round
+    permutation is handed to :class:`data.native.StackedBatchGatherer`,
+    so prefetch overlap carries over to stacked feeds; the numpy path is
+    bit-identical (same indices, same order).
+    """
+
+    def __init__(
+        self,
+        dataset: Dataset,
+        trial: TrialMesh,
+        batch_size: int,
+        seeds: list[int],
+        *,
+        use_native: Optional[bool] = None,
+    ):
+        if batch_size % trial.data_size != 0:
+            raise ValueError(
+                f"batch_size {batch_size} must divide evenly over the "
+                f"trial's data axis of {trial.data_size} devices "
+                "(static per-device shapes)"
+            )
+        if not seeds:
+            raise ValueError("stacked iterator needs at least one lane")
+        self.dataset = dataset
+        self.trial = trial
+        self.batch_size = batch_size
+        self.num_lanes = len(seeds)
+        self.num_batches = len(dataset) // batch_size
+        if self.num_batches == 0:
+            raise ValueError(
+                f"dataset of {len(dataset)} rows smaller than one batch "
+                f"of {batch_size}"
+            )
+        # Per-lane stream state: (seed, epoch) fully determines a lane's
+        # permutation — identical seeding to TrialDataIterator, which is
+        # the whole parity contract.
+        self._lanes = [{"seed": s, "epoch": 1} for s in seeds]
+        self._use_native = False
+        if use_native is not False:
+            from multidisttorch_tpu.data import native
+
+            if native.available():
+                self._use_native = True
+            elif use_native:
+                raise RuntimeError("native fastloader unavailable")
+
+    def set_lane(self, k: int, seed: int, epoch: int = 1) -> None:
+        """Rebind lane ``k`` to a fresh (seed, epoch) stream (refill)."""
+        self._lanes[k] = {"seed": seed, "epoch": epoch}
+
+    @property
+    def samples_per_epoch(self) -> int:
+        """Rows each lane consumes per round (drop-tail, like the
+        unstacked iterator)."""
+        return self.num_batches * self.batch_size
+
+    def _round_perms(self) -> np.ndarray:
+        """(K, N) permutations for every lane's CURRENT epoch."""
+        return np.stack(
+            [
+                epoch_permutation(
+                    lane["seed"], lane["epoch"], np.arange(len(self.dataset))
+                )
+                for lane in self._lanes
+            ]
+        )
+
+    def _advance_epochs(self) -> None:
+        for lane in self._lanes:
+            lane["epoch"] += 1
+
+    def _put(self, rows: np.ndarray, extra_leading: int = 1):
+        """Place a stacked array: the batch-row dim (after
+        ``extra_leading`` stacking dims) is sharded over the submesh
+        data axis; stacking dims stay replicated (the trial axis is a
+        vmap axis, not a mesh axis)."""
+        from multidisttorch_tpu.parallel.mesh import DATA_AXIS
+
+        sh = self.trial.sharding(*([None] * extra_leading), DATA_AXIS)
+        if jax.process_count() == 1:
+            return jax.device_put(rows, sh)
+        return jax.make_array_from_callback(
+            rows.shape, sh, lambda idx: rows[idx]
+        )
+
+    def _host_round(self) -> Iterator[np.ndarray]:
+        """Yield ``num_batches`` host-side ``(K, B, D)`` arrays for one
+        lockstep round, then advance every lane's epoch."""
+        perms = self._round_perms()
+        k, bs = self.num_lanes, self.batch_size
+        if self._use_native:
+            from multidisttorch_tpu.data.native import StackedBatchGatherer
+
+            g = StackedBatchGatherer(self.dataset.images)
+            try:
+                n = g.start_round(perms, bs)
+                for _ in range(n):
+                    yield g.next_stacked()
+            finally:
+                g.close()
+        else:
+            for b in range(self.num_batches):
+                idx = perms[:, b * bs : (b + 1) * bs].reshape(-1)
+                yield self.dataset.images[idx].reshape(k, bs, -1)
+        self._advance_epochs()
+
+    def round_batches(self) -> Iterator:
+        """One lockstep round as per-step device-ready ``[K, B, ...]``
+        batches (the :func:`make_stacked_train_step` feed shape)."""
+        for stacked_np in self._host_round():
+            yield self._put(stacked_np)
+
+    def round_chunks(self, k_steps: int) -> Iterator:
+        """One lockstep round as ``(start_batch_index, [S, K, B, ...])``
+        chunks (the :func:`make_stacked_multi_step` feed shape), the
+        final chunk possibly short — same tail contract as
+        :meth:`TrialDataIterator.epoch_chunks`."""
+        TrialDataIterator._check_chunk_size(k_steps)
+        buf, start = [], 0
+        for i, stacked_np in enumerate(self._host_round()):
+            buf.append(stacked_np)
+            if len(buf) == k_steps:
+                yield start, self._put(np.stack(buf), extra_leading=2)
+                start = i + 1
+                buf = []
+        if buf:
+            yield start, self._put(np.stack(buf), extra_leading=2)
 
 
 class EvalDataIterator:

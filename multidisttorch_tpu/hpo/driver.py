@@ -46,19 +46,31 @@ from dataclasses import dataclass, field, asdict
 from typing import Iterator, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
 
 from multidisttorch_tpu.data.datasets import Dataset
-from multidisttorch_tpu.data.sampler import EvalDataIterator, TrialDataIterator
+from multidisttorch_tpu.data.sampler import (
+    EvalDataIterator,
+    StackedTrialDataIterator,
+    TrialDataIterator,
+)
 from multidisttorch_tpu.models.vae import VAE
 from multidisttorch_tpu.parallel.mesh import TrialMesh, setup_groups
 from multidisttorch_tpu.train.checkpoint import restore_state, save_state
 from multidisttorch_tpu.train.steps import (
+    TrialHypers,
+    build_lane_state,
+    create_stacked_train_state,
     create_train_state,
     make_eval_step,
+    make_lane_ops,
     make_multi_step,
     make_sample_step,
+    make_stacked_eval_step,
+    make_stacked_multi_step,
+    make_stacked_train_step,
     make_train_step,
     state_shardings,
 )
@@ -127,8 +139,14 @@ class TrialResult:
     dataset_synthetic: bool = False
     # Host↔device round-trips the trial actually paid for metric
     # fetches (the O(1)-syncs discipline: ≤ log lines + 2 per epoch;
-    # regression-tested in tests/test_hpo.py).
+    # regression-tested in tests/test_hpo.py). For a stacked trial this
+    # counts its whole bucket's fetches during the trial's lifetime —
+    # the bucket pays them once for ALL lanes.
     host_syncs: int = 0
+    # True when the trial ran as one lane of a stacked bucket
+    # (docs/STACKING.md): K same-shape trials vmapped through one
+    # compiled program on one submesh.
+    stacked: bool = False
 
 
 class _TrialRun:
@@ -687,6 +705,346 @@ class _TrialRun:
         self._log(f"Done. time: {self.result.wall_s:f}")
 
 
+def stack_bucket_key(cfg: TrialConfig) -> tuple:
+    """The shape signature under which trials may share one compiled
+    stacked program: everything that changes an array shape or the
+    compiled step structure. Scalar hypers (lr, beta, seed) and the
+    epoch target deliberately stay OUT — they are the vmapped axis."""
+    return (
+        cfg.batch_size,
+        cfg.hidden_dim,
+        cfg.latent_dim,
+        cfg.fused_steps,
+        cfg.grad_accum,
+        cfg.remat,
+    )
+
+
+def config_is_stackable(cfg: TrialConfig) -> bool:
+    """Whether a config can ride a stacked bucket at all. Sampled eval
+    is the one per-trial knob the stacked eval step does not carry
+    (posterior-mean eval only); such configs run the classic path."""
+    return not cfg.eval_sampled
+
+
+class _StackedBucketRun:
+    """One shape-bucket of K stacked trials on ONE submesh, as a
+    cooperative generator (the stacked sibling of :class:`_TrialRun`).
+
+    All lanes advance in lockstep rounds of ``num_batches`` optimizer
+    steps (one round = one epoch for every lane, since bucket members
+    share dataset and batch size by construction); each dispatch is one
+    vmapped program advancing every lane at once, scan-chunked by the
+    bucket's ``fused_steps``. A lane that reaches its config's epoch
+    target retires — its result and checkpoint are captured from a
+    compiled lane-slice read — and is refilled in place from the
+    bucket's pending queue (``write_lane``; traced lane index, so no
+    recompilation ever) or masked inactive when the queue is dry.
+
+    Per-trial RNG discipline matches the unstacked *per-step* path
+    exactly (``fold_in(key(seed+1), step)``), so a stacked trial's
+    weights are bit-identical to the same config run unstacked with
+    ``fused_steps=1`` — the parity contract tests/test_stacking.py
+    enforces.
+    """
+
+    def __init__(
+        self,
+        trial: TrialMesh,
+        items: Sequence[tuple[int, TrialConfig]],
+        train_data: Dataset,
+        test_data: Optional[Dataset],
+        out_dir: str,
+        *,
+        max_lanes: int = 8,
+        save_checkpoint: bool = True,
+        verbose: bool = True,
+    ):
+        template = items[0][1]
+        for _, cfg in items:
+            if stack_bucket_key(cfg) != stack_bucket_key(template):
+                raise ValueError(
+                    "stacked bucket mixes shape keys: "
+                    f"{stack_bucket_key(cfg)} vs {stack_bucket_key(template)}"
+                )
+        self.trial = trial
+        self.out_dir = out_dir
+        self.queue: list[tuple[int, TrialConfig]] = list(items)
+        self.results: dict[int, TrialResult] = {}
+        self._save_checkpoint = save_checkpoint
+        self._verbose = verbose
+        self._host_syncs = 0
+        self._is_writer = trial.is_writer_process
+
+        self.model = VAE(
+            hidden_dim=template.hidden_dim, latent_dim=template.latent_dim
+        )
+        self.fused = template.fused_steps
+        self.batch_size = template.batch_size
+        self._train_name = train_data.name
+        self._train_synthetic = train_data.synthetic
+
+        k = min(len(self.queue), max_lanes)
+        first = [self.queue.pop(0) for _ in range(k)]
+        # Per-lane host bookkeeping; None = lane retired and unfillable.
+        self.lanes: list[Optional[dict]] = [
+            self._fresh_lane(i, cfg) for i, cfg in first
+        ]
+        self.data = StackedTrialDataIterator(
+            train_data, trial, self.batch_size,
+            seeds=[lane["cfg"].seed for lane in self.lanes],
+        )
+        self.test_iter = (
+            EvalDataIterator(test_data, trial, self.batch_size)
+            if test_data is not None and len(test_data) > 0
+            else None
+        )
+        step_kw = dict(remat=template.remat, grad_accum=template.grad_accum)
+        self.sstep = make_stacked_train_step(trial, self.model, **step_kw)
+        self.smulti = (
+            make_stacked_multi_step(trial, self.model, **step_kw)
+            if self.fused > 1
+            else None
+        )
+        self.seval = (
+            make_stacked_eval_step(trial, self.model)
+            if self.test_iter is not None
+            else None
+        )
+        self.read_lane, self.write_lane = make_lane_ops(trial)
+        self.state = create_stacked_train_state(
+            trial, self.model, [lane["cfg"].seed for lane in self.lanes]
+        )
+        self._refresh_lane_arrays()
+
+    def _fresh_lane(self, idx: int, cfg: TrialConfig) -> dict:
+        return {
+            "idx": idx,
+            "cfg": cfg,
+            "epochs_done": 0,
+            "history": [],
+            "steps": 0,
+            "t0": time.time(),
+            "syncs0": self._host_syncs,
+        }
+
+    def _refresh_lane_arrays(self) -> None:
+        """Rebuild the per-dispatch (K,) arrays after fill/retire/refill.
+        Retired lanes keep placeholder hypers under a 0.0 active mask —
+        the compiled program never changes shape."""
+        def per_lane(fn, default):
+            return [
+                fn(lane["cfg"]) if lane is not None else default
+                for lane in self.lanes
+            ]
+
+        self.hypers = TrialHypers.stack(
+            per_lane(lambda c: c.lr, 1e-3),
+            per_lane(lambda c: c.beta, 1.0),
+            active=per_lane(lambda c: 1.0, 0.0),
+        )
+        self.base_rngs = jnp.stack(
+            [
+                jax.random.key((lane["cfg"].seed if lane else 0) + 1)
+                for lane in self.lanes
+            ]
+        )
+
+    def _lane_steps(self):
+        return jnp.asarray(
+            [lane["steps"] if lane else 0 for lane in self.lanes], jnp.int32
+        )
+
+    def _log(self, *args):
+        if self._verbose:
+            log0(*args, trial=self.trial)
+
+    def _bump_steps(self, n: int) -> None:
+        for lane in self.lanes:
+            if lane is not None:
+                lane["steps"] += n
+
+    def _retire(self, k: int) -> None:
+        """Capture lane k's result + checkpoint, then refill or mask."""
+        lane = self.lanes[k]
+        cfg: TrialConfig = lane["cfg"]
+        lane_out_dir = os.path.join(self.out_dir, f"trial-{cfg.trial_id}")
+        result = TrialResult(
+            trial_id=cfg.trial_id,
+            group_id=self.trial.group_id,
+            config=cfg,
+            history=list(lane["history"]),
+            out_dir=lane_out_dir,
+            dataset=self._train_name,
+            dataset_synthetic=self._train_synthetic,
+            stacked=True,
+        )
+        last = lane["history"][-1]
+        result.final_train_loss = last["avg_train_loss"]
+        result.final_test_loss = last.get("test_loss", float("nan"))
+        result.steps = lane["steps"]
+        result.wall_s = time.time() - lane["t0"]
+        result.host_syncs = self._host_syncs - lane["syncs0"]
+
+        # Lane slice out of the stacked state: a compiled dynamic-index
+        # read (traced k — every retirement reuses one executable).
+        lane_state = self.read_lane(self.state, np.int32(k))
+        if self._is_writer:
+            if self._save_checkpoint:
+                host_state = jax.device_get(lane_state)
+                ckpt = os.path.join(lane_out_dir, "state.msgpack")
+                save_state(
+                    host_state,
+                    ckpt,
+                    metadata={
+                        **asdict(cfg),
+                        "completed_epochs": lane["epochs_done"],
+                        "step": int(host_state.step),
+                        "history": list(lane["history"]),
+                    },
+                )
+                result.checkpoint = ckpt
+            os.makedirs(lane_out_dir, exist_ok=True)
+            with open(os.path.join(lane_out_dir, "metrics.json"), "w") as f:
+                json.dump(
+                    {
+                        "trial_id": result.trial_id,
+                        "group_id": result.group_id,
+                        "config": asdict(cfg),
+                        "dataset": result.dataset,
+                        "dataset_synthetic": result.dataset_synthetic,
+                        "history": result.history,
+                        "wall_s": result.wall_s,
+                        "steps": result.steps,
+                        "stacked": True,
+                    },
+                    f,
+                    indent=2,
+                )
+        self.results[lane["idx"]] = result
+        self._log(
+            f"Trial {cfg.trial_id} done (stacked lane {k}). "
+            f"time: {result.wall_s:f}"
+        )
+
+        if self.queue:
+            idx, nxt = self.queue.pop(0)
+            self.lanes[k] = self._fresh_lane(idx, nxt)
+            self.state = self.write_lane(
+                self.state,
+                self.trial.device_put(build_lane_state(self.model, nxt.seed)),
+                np.int32(k),
+            )
+            self.data.set_lane(k, nxt.seed)
+            self._log(
+                f"Trial {nxt.trial_id} refilled into stacked lane {k} "
+                "(no recompilation)"
+            )
+        else:
+            self.lanes[k] = None  # masked out by active=0.0
+        self._refresh_lane_arrays()
+
+    def unfinished(self) -> list[tuple[int, TrialConfig]]:
+        """Config items not yet completed (failure-isolation support)."""
+        live = [
+            (lane["idx"], lane["cfg"])
+            for lane in self.lanes
+            if lane is not None and lane["idx"] not in self.results
+        ]
+        return live + list(self.queue)
+
+    def run(self) -> Iterator[None]:
+        n_per_epoch = self.data.samples_per_epoch
+        while any(lane is not None for lane in self.lanes):
+            round_sum_dev = None  # (K,) on-device
+
+            def add(dev_sums):
+                nonlocal round_sum_dev
+                round_sum_dev = (
+                    dev_sums
+                    if round_sum_dev is None
+                    else round_sum_dev + dev_sums
+                )
+
+            if self.smulti is None:
+                for batch in self.data.round_batches():
+                    self.state, m = self.sstep(
+                        self.state, self.hypers, batch,
+                        self.base_rngs, self._lane_steps(),
+                    )
+                    self._bump_steps(1)
+                    add(m["loss_sum"])
+                    yield
+            else:
+                for start, chunk in self.data.round_chunks(self.fused):
+                    s = chunk.shape[0]
+                    if s == self.fused:
+                        self.state, m = self.smulti(
+                            self.state, self.hypers, chunk,
+                            self.base_rngs, self._lane_steps(),
+                        )
+                        self._bump_steps(s)
+                        add(m["loss_sum"].sum(axis=0))
+                    else:
+                        # Tail shorter than the compiled chunk: per-step
+                        # stacked dispatches (no extra compilation).
+                        for j in range(s):
+                            self.state, m = self.sstep(
+                                self.state, self.hypers, chunk[j],
+                                self.base_rngs, self._lane_steps(),
+                            )
+                            self._bump_steps(1)
+                            add(m["loss_sum"])
+                    yield
+
+            # One fetch for every lane's epoch average (O(1)-syncs rule:
+            # the bucket pays per-round what one trial used to pay).
+            self._host_syncs += 1
+            train_sums = np.asarray(round_sum_dev)
+
+            test_sums = None
+            if self.test_iter is not None:
+                test_dev = None
+                for tbatch, tweights in self.test_iter.batches():
+                    out = self.seval(self.state, self.hypers, tbatch, tweights)
+                    test_dev = (
+                        out["loss_sum"]
+                        if test_dev is None
+                        else test_dev + out["loss_sum"]
+                    )
+                    yield
+                self._host_syncs += 1
+                test_sums = np.asarray(test_dev)
+
+            retiring = []
+            for k, lane in enumerate(self.lanes):
+                if lane is None:
+                    continue
+                lane["epochs_done"] += 1
+                avg = float(train_sums[k]) / n_per_epoch
+                record = {"epoch": lane["epochs_done"], "avg_train_loss": avg}
+                self._log(
+                    "Trial {} ====> Epoch: {} Average loss: {:.4f}".format(
+                        lane["cfg"].trial_id, lane["epochs_done"], avg
+                    )
+                )
+                if test_sums is not None:
+                    t = float(test_sums[k]) / self.test_iter.num_rows
+                    record["test_loss"] = t
+                    self._log(
+                        "Trial {} ====> Test set loss: {:.4f}".format(
+                            lane["cfg"].trial_id, t
+                        )
+                    )
+                lane["history"].append(record)
+                if lane["epochs_done"] >= lane["cfg"].epochs:
+                    retiring.append(k)
+            for k in retiring:
+                self._retire(k)
+                yield
+        jax.block_until_ready(self.state.params)
+
+
 def run_hpo(
     configs: Sequence[TrialConfig],
     train_data: Dataset,
@@ -705,6 +1063,8 @@ def run_hpo(
     resilient: bool = False,
     resume: bool = False,
     profile_dir: Optional[str] = None,
+    stack_trials: bool = False,
+    stack_max_lanes: int = 8,
 ) -> list[TrialResult]:
     """Run the configs over disjoint submeshes, concurrently, with no
     cross-trial synchronization.
@@ -752,6 +1112,21 @@ def run_hpo(
     the tool for confirming submeshes stay busy and finding host-side
     dispatch contention (SURVEY.md §7 "hard parts").
 
+    ``stack_trials=True`` enables the trial-stacking execution mode
+    (docs/STACKING.md): when trials outnumber groups, configs sharing a
+    shape bucket (:func:`stack_bucket_key` — same architecture and
+    batch size, any lr/beta/seed/epochs) run K-at-a-time on ONE submesh
+    through one vmapped program (``train.steps.make_stacked_*``), with
+    finished trials retired and refilled in place without recompiling.
+    Falls back to the classic one-trial-per-group path when there is
+    nothing to stack (too few configs, or unstackable knobs). At most
+    ``stack_max_lanes`` trials share one program. Single-controller
+    only, default model family only; the driver raises on contradictory
+    settings (``resume``, ``shard_across_trials``, custom
+    ``model_builder`` / weight sharding) rather than silently running a
+    different sweep; ``save_images`` is ignored for stacked buckets
+    (no reconstruction/sample grids — run image trials unstacked).
+
     Returns results for locally-run trials, in config order.
     """
     if profile_dir is not None:
@@ -779,6 +1154,8 @@ def run_hpo(
             param_shardings_builder=param_shardings_builder,
             resilient=resilient,
             resume=resume,
+            stack_trials=stack_trials,
+            stack_max_lanes=stack_max_lanes,
         )
 
 
@@ -833,6 +1210,8 @@ def _run_hpo_body(
     param_shardings_builder,
     resilient,
     resume,
+    stack_trials=False,
+    stack_max_lanes=8,
 ) -> list[TrialResult]:
     if groups is None:
         groups = setup_groups(
@@ -907,30 +1286,132 @@ def _run_hpo_body(
     # balanced_assignment's docstring for the caveat) while remaining
     # process-independent.
     single = jax.process_count() == 1
-    shared: list[tuple[int, TrialConfig]] = list(enumerate(configs))
-    per_group: dict[int, list[tuple[int, TrialConfig]]] = {
-        g.group_id: [] for g in groups
-    }
+    if stack_trials:
+        # Trial stacking is single-controller, default-model-family
+        # territory; contradictory settings fail loudly rather than
+        # silently running a different sweep than asked for.
+        if not single:
+            raise ValueError(
+                "stack_trials: stacking is single-controller only (the "
+                "stacked state lives on one submesh; multi-controller "
+                "lane scheduling would need cross-process agreement)"
+            )
+        if resume:
+            raise ValueError(
+                "stack_trials is incompatible with resume= (lane "
+                "restore into a stacked bucket is not implemented; run "
+                "the resume sweep unstacked)"
+            )
+        if shard_across_trials:
+            raise ValueError(
+                "stack_trials is incompatible with shard_across_trials "
+                "(stacked lanes each see the full dataset)"
+            )
+        if model_builder is not None or param_shardings_builder is not None \
+                or model_parallel != 1:
+            raise ValueError(
+                "stack_trials supports the default VAE family with "
+                "replicated weights only (custom model_builder / "
+                "param_shardings_builder / model_parallel cannot share "
+                "one vmapped program)"
+            )
+
+    # Work items: ("single", [(i, cfg)]) or ("bucket", [(i, cfg), ...]).
+    # Stacking applies only when trials outnumber groups — otherwise
+    # every trial gets its own submesh and stacking would only serialize.
+    def build_items() -> list[tuple[str, list[tuple[int, TrialConfig]]]]:
+        indexed = list(enumerate(configs))
+        if not (stack_trials and len(configs) > len(groups)):
+            return [("single", [item]) for item in indexed]
+        buckets: dict[tuple, list] = {}
+        singles: list = []
+        for item in indexed:
+            if config_is_stackable(item[1]):
+                buckets.setdefault(stack_bucket_key(item[1]), []).append(item)
+            else:
+                singles.append(item)
+        items = []
+        for members in buckets.values():
+            if len(members) >= 2:
+                items.append(("bucket", members))
+            else:
+                singles.extend(members)
+        items.extend(("single", [m]) for m in singles)
+        # Don't idle submeshes behind one mega-bucket: split the largest
+        # bucket until there is at least one work item per group (or
+        # nothing left to split).
+        while len(items) < len(groups):
+            big = max(
+                (it for it in items if it[0] == "bucket" and len(it[1]) >= 4),
+                key=lambda it: len(it[1]),
+                default=None,
+            )
+            if big is None:
+                break
+            items.remove(big)
+            half = len(big[1]) // 2
+            items.append(("bucket", big[1][:half]))
+            items.append(("bucket", big[1][half:]))
+        # Deterministic order: by first member's config index.
+        items.sort(key=lambda it: it[1][0][0])
+        return items
+
+    shared = build_items()
+    per_group: dict[int, list] = {g.group_id: [] for g in groups}
     if not single:
         assignment = balanced_assignment(
             [predicted_cost(cfg, len(train_data)) for cfg in configs],
             len(groups),
         )
         for i, cfg in enumerate(configs):
-            per_group[groups[assignment[i]].group_id].append((i, cfg))
+            per_group[groups[assignment[i]].group_id].append(
+                ("single", [(i, cfg)])
+            )
     queue_of = (
         (lambda g: shared) if single else (lambda g: per_group[g.group_id])
     )
 
     local_groups = [g for g in groups if g.is_local_member]
     results: dict[int, TrialResult] = {}
-    # group -> (config_index, run, generator) of its in-flight trial
-    active: dict[int, tuple[int, _TrialRun, Iterator[None]]] = {}
+    # group -> (kind, config_index_or_None, run, generator) in flight
+    active: dict[int, tuple] = {}
+
+    def fail_items(g, members, error_text) -> None:
+        for i, cfg in members:
+            results[i] = TrialResult(
+                trial_id=cfg.trial_id,
+                group_id=g.group_id,
+                config=cfg,
+                status="failed",
+                error=error_text,
+            )
 
     def start_next(g: TrialMesh) -> bool:
         q = queue_of(g)
         while q:
-            i, cfg = q.pop(0)
+            kind, members = q.pop(0)
+            if kind == "bucket":
+                try:
+                    brun = _StackedBucketRun(
+                        g, members, train_data, test_data, out_dir,
+                        max_lanes=stack_max_lanes,
+                        save_checkpoint=save_checkpoints,
+                        verbose=verbose,
+                    )
+                except Exception as e:  # noqa: BLE001 — setup isolation
+                    error_text = f"{type(e).__name__}: {e}"
+                    fail_items(g, members, error_text)
+                    if not resilient:
+                        raise
+                    log0(
+                        f"Stacked bucket of {len(members)} trials FAILED "
+                        f"at setup ({error_text}); sweep continues",
+                        trial=g,
+                    )
+                    continue
+                active[g.group_id] = ("bucket", None, brun, brun.run())
+                return True
+            i, cfg = members[0]
             err: Optional[BaseException] = None
             run: Optional[_TrialRun] = None
             try:
@@ -972,31 +1453,53 @@ def _run_hpo_body(
                     trial=g,
                 )
                 continue
-            active[g.group_id] = (i, run, run.run())
+            active[g.group_id] = ("single", i, run, run.run())
             return True
         return False
 
     for g in local_groups:
         start_next(g)
 
-    # Cooperative round-robin: one async step dispatch per trial per
-    # cycle. A finished (or failed) trial frees its submesh, which
-    # immediately starts its next queued config — the sweep's wall-clock
-    # is bounded by real work, never by barriers (Q3 fixed).
+    # Cooperative round-robin: one async step dispatch per trial (or
+    # stacked bucket — K trials per dispatch) per cycle. A finished (or
+    # failed) item frees its submesh, which immediately starts its next
+    # queued work — the sweep's wall-clock is bounded by real work,
+    # never by barriers (Q3 fixed).
     while active:
         for g in local_groups:
             if g.group_id not in active:
                 continue
-            i, run, gen = active[g.group_id]
+            kind, i, run, gen = active[g.group_id]
             try:
                 next(gen)
             except StopIteration:
-                results[i] = run.result
+                if kind == "bucket":
+                    results.update(run.results)
+                else:
+                    results[i] = run.result
                 del active[g.group_id]
                 start_next(g)
             except Exception as e:  # noqa: BLE001 — failure isolation
+                error_text = f"{type(e).__name__}: {e}"
+                if kind == "bucket":
+                    # Lanes already retired keep their completed
+                    # results; everything in flight or queued in the
+                    # bucket fails together (they shared the broken
+                    # program/state).
+                    results.update(run.results)
+                    fail_items(g, run.unfinished(), error_text)
+                    del active[g.group_id]
+                    if not resilient:
+                        raise
+                    log0(
+                        f"Stacked bucket FAILED ({error_text}); "
+                        "submesh freed, sweep continues",
+                        trial=g,
+                    )
+                    start_next(g)
+                    continue
                 run.result.status = "failed"
-                run.result.error = f"{type(e).__name__}: {e}"
+                run.result.error = error_text
                 results[i] = run.result
                 del active[g.group_id]
                 # Drain any in-flight checkpoint write before freeing the

@@ -29,6 +29,10 @@ from functools import lru_cache, partial
 import jax
 import jax.numpy as jnp
 
+from multidisttorch_tpu.utils.compat import (
+    pallas_tpu_compiler_params,
+    shard_map as compat_shard_map,
+)
 from multidisttorch_tpu.ops.ring_attention import dense_attention_reference
 
 try:
@@ -70,8 +74,13 @@ def _out_struct(shape, dtype, like):
     staged forward, parallel/pipeline.py) a pallas_call must declare
     its outputs' VMA explicitly or tracing rejects it; propagating the
     input's vma makes the kernels VMA-transparent (outside shard_map
-    ``typeof(x).vma`` is empty and this is a no-op)."""
-    return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
+    ``typeof(x).vma`` is empty and this is a no-op). Jaxlibs that
+    predate VMA typing (0.4.x — no ``jax.typeof``, no ``vma=`` kwarg,
+    and shard_map runs with the legacy ``check_rep`` checker instead,
+    utils/compat.py) need no annotation at all."""
+    if hasattr(jax, "typeof"):
+        return jax.ShapeDtypeStruct(shape, dtype, vma=jax.typeof(like).vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 # ---------------------------------------------------------------------
@@ -174,7 +183,7 @@ def _fwd_call(q, k, v, scale, causal):
             pltpu.VMEM((bq, 1), jnp.float32),   # running max
             pltpu.VMEM((bq, 1), jnp.float32),   # running sum
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
@@ -328,7 +337,7 @@ def _bwd_call(q, k, v, o, lse, do, scale, causal, g_lse=None):
         out_specs=wide(bq),
         out_shape=_out_struct(q.shape, q.dtype, q),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
@@ -349,7 +358,7 @@ def _bwd_call(q, k, v, o, lse, do, scale, causal, g_lse=None):
             pltpu.VMEM((bk, d), jnp.float32),
             pltpu.VMEM((bk, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=pallas_tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
@@ -546,7 +555,7 @@ def _make_ring_flash_cached(mesh, causal: bool, head_axis=None):
 
     def fn(q, k, v):
         scale = 1.0 / (q.shape[-1] ** 0.5)
-        return jax.shard_map(
+        return compat_shard_map(
             partial(
                 _ring_flash_local,
                 axis_name=DATA_AXIS,

@@ -84,14 +84,21 @@ def _fwd_kernel(logits_ref, x_ref, mu_ref, logvar_ref, out_ref, *, beta):
     part = bce + beta * kl
 
     # Scalar accumulation across the (sequential) batch-block grid: the
-    # SMEM output block is the same (0,0) cell every step.
+    # SMEM output block is the same (0,0) cell every step. Every store
+    # casts to the REF's dtype explicitly: Mosaic rejects a swap whose
+    # value dtype strays from the ref (the round-4 hardware failure —
+    # "Invalid dtype for swap: Ref float32 vs value bfloat16" — when
+    # bf16 operands reached this accumulator; interpret mode casts
+    # silently, so only the explicit cast keeps both worlds identical).
     @pl.when(pl.program_id(0) == 0)
     def _init():
-        out_ref[0, 0] = part
+        out_ref[0, 0] = part.astype(out_ref.dtype)
 
     @pl.when(pl.program_id(0) > 0)
     def _acc():
-        out_ref[0, 0] = out_ref[0, 0] + part
+        out_ref[0, 0] = (
+            out_ref[0, 0].astype(jnp.float32) + part
+        ).astype(out_ref.dtype)
 
 
 def _bwd_kernel(logits_ref, x_ref, mu_ref, logvar_ref,

@@ -15,10 +15,18 @@ from multidisttorch_tpu.train.lm_quant import (
 )
 from multidisttorch_tpu.train.steps import (
     TrainState,
+    TrialHypers,
+    build_lane_state,
+    build_stacked_train_state,
+    create_stacked_train_state,
     create_train_state,
     make_eval_step,
+    make_lane_ops,
     make_multi_step,
     make_sample_step,
+    make_stacked_eval_step,
+    make_stacked_multi_step,
+    make_stacked_train_step,
     make_train_step,
     state_shardings,
 )

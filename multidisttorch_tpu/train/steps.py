@@ -22,13 +22,14 @@ logging arithmetic (``vae-hpo.py:83,89,118``) carries over unchanged.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
 import optax
 from flax import struct
 
+from multidisttorch_tpu.utils.compat import shard_map as compat_shard_map
 from multidisttorch_tpu.models.vae import VAE
 from multidisttorch_tpu.ops.losses import elbo_loss_sum
 from multidisttorch_tpu.parallel.mesh import DATA_AXIS, TrialMesh
@@ -256,7 +257,7 @@ def _build_step_fn(
             # shard_map and psum the partial sums instead — each chip
             # reduces only its own batch rows.
             def loss_impl(logits, x, mu, logvar, beta):
-                return jax.shard_map(
+                return compat_shard_map(
                     lambda lo, xx, m, lv: jax.lax.psum(
                         fused_elbo_loss_sum(lo, xx, m, lv, beta), _AXIS
                     ),
@@ -407,6 +408,331 @@ def make_multi_step(
         out_shardings=(state_sh, repl),
         donate_argnums=(0,),
     )
+
+
+# --- trial stacking: K same-shape trials through ONE compiled program ---
+#
+# At the flagship's size a whole train step is microseconds of MXU time,
+# so a sweep of small trials is dispatch-bound no matter how its
+# submeshes are carved (docs/DISPATCH.md; VERDICT pins flagship MFU at
+# 0.13-0.25 with dispatch as prime suspect). Scan-fusion amortizes
+# dispatch *in time* (more steps per call); stacking amortizes it *in
+# trials*: bucket K configs that share every array shape (architecture,
+# batch size) and differ only in scalar hypers (lr, beta, seed), stack
+# their states along a leading trial axis, and vmap the step body over
+# that axis — XLA fuses K trials' matmuls into batched ops inside one
+# program, so one host dispatch advances K trials (the DrJAX
+# mapped-workload construction, arXiv:2403.07128). Composes with
+# lax.scan chunking: one dispatch = fused_steps x K optimizer updates.
+#
+# Per-trial hypers ride in as batched arrays (TrialHypers); the
+# optimizer is rebuilt per-lane inside the vmap from the traced lr as
+# chain(scale_by_adam, scale(-lr)) — the literal definition of
+# optax.adam(lr), so state trees AND update math are bit-identical to
+# the unstacked driver path (regression-tested in tests/test_stacking).
+# `active` masks a lane's parameter updates (x1.0 live, x0.0 retired):
+# a finished trial's lane keeps flowing through the same compiled
+# program with frozen params until the driver refills the lane with the
+# next queued config (`write_lane`) — retirement and refill never
+# recompile.
+
+
+@struct.dataclass
+class TrialHypers:
+    """Per-lane scalar hyperparameters of a stacked trial bucket, each
+    shape ``(K,)``: the vmapped axis of everything that may differ
+    between bucket members without changing the compiled program."""
+
+    lr: jnp.ndarray
+    beta: jnp.ndarray
+    # 1.0 = lane training; 0.0 = lane retired (updates masked to zero,
+    # params frozen at their final values until the lane is refilled).
+    active: jnp.ndarray
+
+    @staticmethod
+    def stack(lrs, betas, active=None) -> "TrialHypers":
+        lrs = jnp.asarray(lrs, jnp.float32)
+        return TrialHypers(
+            lr=lrs,
+            beta=jnp.asarray(betas, jnp.float32),
+            active=(
+                jnp.ones_like(lrs)
+                if active is None
+                else jnp.asarray(active, jnp.float32)
+            ),
+        )
+
+
+def build_lane_state(model: VAE, seed: int) -> TrainState:
+    """One lane's fresh :class:`TrainState` (un-placed, no leading axis).
+
+    Adam's init is learning-rate-independent (zero moments + count), so
+    a single builder serves every lane regardless of its lr — the same
+    tree :func:`build_train_state` produces for the unstacked driver
+    path, which is what keeps stacked/unstacked checkpoints
+    interchangeable."""
+    return build_train_state(model, optax.adam(1.0), jax.random.key(seed))
+
+
+def build_stacked_train_state(model: VAE, seeds: Sequence[int]) -> TrainState:
+    """Stack K per-seed lane states along a new leading trial axis."""
+    lanes = [build_lane_state(model, s) for s in seeds]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *lanes)
+
+
+def create_stacked_train_state(
+    trial: TrialMesh, model: VAE, seeds: Sequence[int]
+) -> TrainState:
+    """Build and place a stacked state: every leaf gains a leading
+    ``K = len(seeds)`` axis, replicated over the submesh (the trial axis
+    is the vmap axis, never a mesh axis — lanes are data-independent by
+    construction, so there is nothing to communicate between them)."""
+    return trial.device_put(build_stacked_train_state(model, seeds))
+
+
+def _lane_fold_rngs(base_rngs: jax.Array, lane_steps: jnp.ndarray) -> jax.Array:
+    """Per-lane step keys: ``fold_in(base_k, step_k)`` — the SAME stream
+    as the unstacked per-step driver path (driver.py folds its trial key
+    with the global optimizer-step count), which is what makes
+    stacked-vs-unstacked bit-for-bit parity possible."""
+    return jax.vmap(jax.random.fold_in)(base_rngs, lane_steps)
+
+
+def _stacked_lane_body(
+    trial: TrialMesh, model: VAE, remat: bool, grad_accum: int
+):
+    """The per-lane step body vmapped by both stacked step builders:
+    ``(state, batch, rng, lr, beta, active) -> (state, loss_sum)`` with
+    lr/beta as traced scalars (the batched-hypers contract) and the
+    optimizer rebuilt from lr as optax.adam's own definition."""
+
+    def forward(params, batch, rng):
+        return model.apply({"params": params}, batch, rngs={"reparam": rng})
+
+    if remat:
+        forward = jax.checkpoint(forward)
+
+    def microbatch_loss(params, mb_batch, mb_rng, beta):
+        m = mb_batch.shape[0]
+        recon_logits, mu, logvar = forward(params, mb_batch, mb_rng)
+        total = elbo_loss_sum(
+            recon_logits, mb_batch.reshape(m, -1), mu, logvar, beta
+        )
+        return total / m
+
+    def lane_body(state, batch, rng, lr, beta, active):
+        n = batch.shape[0]
+        if grad_accum == 1:
+            loss, grads = jax.value_and_grad(microbatch_loss)(
+                state.params, batch, rng, beta
+            )
+        else:
+            loss, _, grads = accumulate_gradients(
+                trial,
+                lambda p, mb, r: (microbatch_loss(p, mb, r, beta), ()),
+                state.params,
+                (batch,),
+                (jax.random.split(rng, grad_accum),),
+                grad_accum=grad_accum,
+            )
+        # optax.adam(lr) IS chain(scale_by_adam, scale(-lr)); building it
+        # from the traced per-lane lr keeps state structure and update
+        # arithmetic bit-identical to the unstacked path.
+        tx = optax.chain(optax.scale_by_adam(), optax.scale(-lr))
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=new_params, opt_state=new_opt, step=state.step + 1
+        )
+        # Retirement mask as a SELECT, not a multiply: `active * update`
+        # changes XLA's FMA contraction around the parameter add and
+        # costs live lanes one ulp vs the unstacked program (measured);
+        # where() picks whole computed values, so live lanes stay
+        # bit-identical and retired lanes stay frozen exactly.
+        new_state = jax.tree.map(
+            lambda new, old: jnp.where(active > 0.5, new, old),
+            new_state,
+            state,
+        )
+        return new_state, (loss * n).astype(jnp.float32)
+
+    return lane_body
+
+
+def make_stacked_train_step(
+    trial: TrialMesh,
+    model: VAE,
+    *,
+    remat: bool = False,
+    grad_accum: int = 1,
+):
+    """One vmapped optimizer step for K stacked trials in ONE dispatch.
+
+    Returns ``step(state, hypers, batch, base_rngs, lane_steps) ->
+    (state, metrics)`` where every ``state`` leaf and ``batch``
+    (``(K, B, ...)``, dim 1 sharded over the submesh data axis) carry a
+    leading trial axis, ``hypers`` is a :class:`TrialHypers` of ``(K,)``
+    arrays, ``base_rngs`` is a ``(K,)`` key array (one per-trial stream,
+    ``key(seed+1)`` in the driver), and ``lane_steps`` ``(K,)`` int32 is
+    each lane's optimizer-step count — folded into its key exactly like
+    the unstacked per-step path, so a stacked trial's RNG stream (and
+    therefore its weights) match the unstacked trial bit-for-bit.
+    ``metrics['loss_sum']`` is ``(K,)``, one summed negative ELBO per
+    trial (the reference logging contract, per lane).
+
+    The fused Pallas ELBO is deliberately NOT plumbed here: its kernel
+    takes beta as a compile-time constant, and per-lane traced betas
+    would force one kernel instance per lane — the XLA loss fuses fine
+    under vmap and benches within noise of the kernel (BENCH r4).
+    """
+    _validate_grad_accum(grad_accum)
+    lane_body = _stacked_lane_body(trial, model, remat, grad_accum)
+    vstep = jax.vmap(lane_body, in_axes=(0, 0, 0, 0, 0, 0))
+    repl = trial.replicated_sharding
+    batch_sh = trial.sharding(None, DATA_AXIS)
+
+    def step_fn(
+        state: TrainState,
+        hypers: TrialHypers,
+        batch: jax.Array,
+        base_rngs: jax.Array,
+        lane_steps: jnp.ndarray,
+    ):
+        rngs = _lane_fold_rngs(base_rngs, lane_steps)
+        state, loss_sums = vstep(
+            state, batch, rngs, hypers.lr, hypers.beta, hypers.active
+        )
+        return state, {"loss_sum": loss_sums}
+
+    return jax.jit(
+        step_fn,
+        in_shardings=(repl, repl, batch_sh, repl, repl),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+
+
+def make_stacked_multi_step(
+    trial: TrialMesh,
+    model: VAE,
+    *,
+    remat: bool = False,
+    grad_accum: int = 1,
+):
+    """``S`` scan-chained vmapped steps: one dispatch = S x K optimizer
+    updates (scan amortizes dispatch in time, the stacked axis amortizes
+    it in trials — the two compose multiplicatively).
+
+    Returns ``multi(state, hypers, batches, base_rngs, lane_steps) ->
+    (state, metrics)`` with ``batches`` of shape ``(S, K, B, ...)``
+    (dim 2 sharded over the submesh data axis) and
+    ``metrics['loss_sum']`` of shape ``(S, K)``. Inner step ``s`` folds
+    ``lane_steps + s`` into each lane's base key — the identical stream
+    to :func:`make_stacked_train_step` called S times, so chunked and
+    per-step stacked training produce bit-identical weights (unlike
+    :func:`make_multi_step`, whose split-based stream is its own).
+    """
+    _validate_grad_accum(grad_accum)
+    lane_body = _stacked_lane_body(trial, model, remat, grad_accum)
+    vstep = jax.vmap(lane_body, in_axes=(0, 0, 0, 0, 0, 0))
+    repl = trial.replicated_sharding
+    batches_sh = trial.sharding(None, None, DATA_AXIS)
+
+    def multi_fn(
+        state: TrainState,
+        hypers: TrialHypers,
+        batches: jax.Array,
+        base_rngs: jax.Array,
+        lane_steps: jnp.ndarray,
+    ):
+        def body(s, xs):
+            b, i = xs
+            rngs = _lane_fold_rngs(base_rngs, lane_steps + i)
+            s, loss_sums = vstep(
+                s, b, rngs, hypers.lr, hypers.beta, hypers.active
+            )
+            return s, loss_sums
+
+        state, losses = jax.lax.scan(
+            body, state, (batches, jnp.arange(batches.shape[0], dtype=jnp.int32))
+        )
+        return state, {"loss_sum": losses}
+
+    return jax.jit(
+        multi_fn,
+        in_shardings=(repl, repl, batches_sh, repl, repl),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+
+
+def make_stacked_eval_step(trial: TrialMesh, model: VAE):
+    """Masked posterior-mean eval for K stacked trials in one dispatch:
+    ``eval(state, hypers, batch, weights) -> {'loss_sum': (K,)}`` — the
+    batch and its pad-mask weights are shared across lanes (every trial
+    scores the same test rows, reference contract), only the state and
+    beta are per-lane."""
+    from multidisttorch_tpu.ops.losses import elbo_loss_weighted_sum
+
+    repl = trial.replicated_sharding
+    data = trial.batch_sharding
+
+    def lane_eval(params, beta, batch, weights):
+        n = batch.shape[0]
+        flat = batch.reshape(n, -1)
+        mu, logvar = model.apply({"params": params}, batch, method="encode")
+        recon_logits = model.apply({"params": params}, mu, method="decode")
+        return elbo_loss_weighted_sum(
+            recon_logits, flat, mu, logvar, weights, beta
+        ).astype(jnp.float32)
+
+    veval = jax.vmap(lane_eval, in_axes=(0, 0, None, None))
+
+    def eval_fn(state: TrainState, hypers: TrialHypers, batch, weights):
+        return {"loss_sum": veval(state.params, hypers.beta, batch, weights)}
+
+    return jax.jit(
+        eval_fn,
+        in_shardings=(repl, repl, data, data),
+        out_shardings=repl,
+    )
+
+
+def make_lane_ops(trial: TrialMesh):
+    """Compiled lane surgery for mask-and-refill: ``(read, write)``.
+
+    ``read(state, k) -> TrainState`` slices lane ``k`` out of a stacked
+    state (checkpoint/result capture at retirement); ``write(state,
+    lane_state, k) -> state`` overwrites lane ``k`` with a freshly
+    initialized lane (refill). ``k`` is a TRACED int32, so every lane
+    index reuses one compiled program each way — a bucket churns through
+    its whole queue with zero recompiles (asserted via ``_cache_size``
+    in tests)."""
+    repl = trial.replicated_sharding
+
+    def read(state: TrainState, k) -> TrainState:
+        return jax.tree.map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, k, 0, keepdims=False),
+            state,
+        )
+
+    def write(state: TrainState, lane: TrainState, k) -> TrainState:
+        return jax.tree.map(
+            lambda a, b: jax.lax.dynamic_update_index_in_dim(
+                a, b.astype(a.dtype), k, 0
+            ),
+            state,
+            lane,
+        )
+
+    read_j = jax.jit(read, in_shardings=(repl, None), out_shardings=repl)
+    write_j = jax.jit(
+        write,
+        in_shardings=(repl, repl, None),
+        out_shardings=repl,
+        donate_argnums=(0,),
+    )
+    return read_j, write_j
 
 
 def make_eval_step(

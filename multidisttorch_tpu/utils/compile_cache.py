@@ -7,6 +7,19 @@ effect is ~4.5x on compile-dominated runs. Kept OUT of any process that
 compiles for the real TPU: the rare chip window gets the exact,
 known-good compile path (callers enforce that policy; this module just
 centralizes the mechanism so the three call sites cannot drift).
+
+DISABLED BY DEFAULT on this toolchain: XLA:CPU executables
+*deserialized* from the persistent cache corrupt the heap on the pinned
+jaxlib (0.4.36 — its CPU thunk-runtime serialization is still
+experimental). Reproduced deterministically: warm the cache with the
+HPO train step, then rebuild the identical program so compilation takes
+the cache-read path — the deserialized executable's first few runs die
+in ``malloc: chunk_main_arena`` / SIGSEGV (this was the seed suite's
+``test_resume_continues_from_checkpoint`` abort that killed every test
+after ``test_hpo.py``). A corrupted process loses whole artifacts and
+test runs; a cold compile only loses seconds — so the cache is now
+opt-in via ``MDT_FORCE_COMPILE_CACHE=1`` for environments whose jaxlib
+serializes CPU executables correctly.
 """
 
 from __future__ import annotations
@@ -25,14 +38,28 @@ def default_cache_dir() -> str:
     return os.path.join(os.path.dirname(pkg), ".jax_cache")
 
 
+def cache_is_safe() -> bool:
+    """Whether persistent-cache *reads* are trusted on this toolchain.
+
+    Opt-in only (``MDT_FORCE_COMPILE_CACHE=1``): the pinned jaxlib's
+    XLA:CPU executable deserialization corrupts the heap (module
+    docstring), and there is no runtime probe that can prove a given
+    jaxlib safe — a corrupted heap fails later, somewhere else.
+    """
+    return os.environ.get("MDT_FORCE_COMPILE_CACHE") == "1"
+
+
 def enable_persistent_compile_cache(cache_dir: str | None = None) -> bool:
     """Point jax at a persistent compilation cache; every compile
     qualifies (min time/size zero). Best-effort: returns False and
-    changes nothing if the directory can't be created or the jax
+    changes nothing if the cache is unsafe on this toolchain
+    (:func:`cache_is_safe`), the directory can't be created, or the jax
     build lacks the knobs — the cache is an optimization, never a new
     failure mode."""
     import jax
 
+    if not cache_is_safe():
+        return False
     path = cache_dir or default_cache_dir()
     try:
         os.makedirs(path, exist_ok=True)

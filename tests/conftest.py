@@ -19,22 +19,18 @@ os.environ["XLA_FLAGS"] = (
 )
 os.environ["JAX_PLATFORMS"] = "cpu"
 
-# Persistent XLA compilation cache: the suite is dominated by jit
-# compiles of the same programs run-over-run (measured 4.5x on the
-# heaviest file), and cache keys are HLO hashes so staleness is
-# impossible by construction. The env vars alone are NOT enough here —
-# sitecustomize pre-imports jax, which freezes env-derived config
-# before this file runs — so mirror them through jax.config.update
-# (same trick as the platform pin below). The env vars still matter:
-# subprocess-spawning tests (multihost worlds, example smokes) inherit
-# them, and those children have no sitecustomize-pre-import problem
-# at the point their conftest-less interpreters start jax fresh.
-_CACHE_DIR = os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"),
-)
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+# Persistent XLA compilation cache: DISABLED here (and everywhere, by
+# default — utils/compile_cache.py) on this toolchain. The pinned
+# jaxlib's XLA:CPU executable deserialization corrupts the heap: with a
+# warm cache, the first suite run to rebuild an already-cached program
+# (test_hpo.py's resume tests rebuild the train step in-process) takes
+# the cache-READ path and dies with SIGSEGV / `malloc:
+# chunk_main_arena`, killing every test after test_hpo.py. A full cold
+# suite costs minutes of recompiles; a corrupted interpreter costs the
+# entire run. Opt back in with MDT_FORCE_COMPILE_CACHE=1 on a jaxlib
+# whose CPU thunk serialization is sound (the env var is honored by
+# enable_persistent_compile_cache, which this harness deliberately no
+# longer calls unconditionally).
 
 import jax
 
@@ -44,7 +40,7 @@ from multidisttorch_tpu.utils.compile_cache import (  # noqa: E402
     enable_persistent_compile_cache,
 )
 
-enable_persistent_compile_cache(_CACHE_DIR)
+enable_persistent_compile_cache()  # no-op unless MDT_FORCE_COMPILE_CACHE=1
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,68 @@
+"""Generate the committed tiny MNIST-format IDX fixture pair.
+
+An INDEPENDENT writer for `tests/fixtures/mnist/`: the bytes are
+assembled here with bare ``struct.pack`` big-endian arithmetic — no
+import of ``multidisttorch_tpu.data.datasets`` — so the fixture cannot
+inherit a bug from the parser it exists to test (a writer built as the
+parser's inverse would round-trip its own mistakes invisibly).
+
+Layout per Yann LeCun's IDX spec:
+  images: magic 0x00000803 (2 zero bytes, dtype 0x08 = ubyte, ndim 3),
+          dims (N, 28, 28) as big-endian uint32, then N*28*28 raw bytes
+  labels: magic 0x00000801, dim (N,), then N raw bytes
+
+Content is a fixed formula (pixel = (7i + 3r + 5c) mod 256,
+label = i mod 10) so the loader test can recompute expected values
+from scratch instead of trusting any intermediate array.
+
+Run from the repo root to (re)generate:
+    python tests/fixtures/gen_mnist_idx.py
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+N, H, W = 64, 28, 28
+OUT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "mnist")
+
+
+def pixel(i: int, r: int, c: int) -> int:
+    return (7 * i + 3 * r + 5 * c) % 256
+
+
+def label(i: int) -> int:
+    return i % 10
+
+
+def image_bytes() -> bytes:
+    header = struct.pack(">HBB", 0, 0x08, 3) + struct.pack(">III", N, H, W)
+    body = bytes(
+        pixel(i, r, c) for i in range(N) for r in range(H) for c in range(W)
+    )
+    return header + body
+
+
+def label_bytes() -> bytes:
+    header = struct.pack(">HBB", 0, 0x08, 1) + struct.pack(">I", N)
+    return header + bytes(label(i) for i in range(N))
+
+
+def main() -> None:
+    os.makedirs(OUT_DIR, exist_ok=True)
+    for name, payload in (
+        ("train-images-idx3-ubyte.gz", image_bytes()),
+        ("train-labels-idx1-ubyte.gz", label_bytes()),
+    ):
+        path = os.path.join(OUT_DIR, name)
+        # mtime=0 keeps the gzip output byte-stable across regenerations
+        with open(path, "wb") as raw:
+            with gzip.GzipFile(fileobj=raw, mode="wb", mtime=0) as f:
+                f.write(payload)
+        print(f"wrote {path} ({os.path.getsize(path)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -117,6 +117,76 @@ def test_kernel_smoke_all_pass():
         assert r[name]["ok"], f"{name}: {r[name].get('error')}"
 
 
+def test_bench_stacked_smoke(monkeypatch):
+    monkeypatch.setattr(bench, "STACKED_TRIALS", 2)
+    monkeypatch.setattr(bench, "STACKED_LEVELS", (1, 2))
+    monkeypatch.setattr(bench, "STACKED_MEASURE_STEPS", 2)
+    monkeypatch.setattr(bench, "STACKED_REPEATS", 1)
+    r = bench.bench_stacked()
+    assert r["trials"] == 2
+    assert [lvl["k"] for lvl in r["levels"]] == [1, 2]
+    for lvl in r["levels"]:
+        assert lvl["samples_per_sec_per_chip"] > 0
+        assert lvl["chips_used"] == min(8, 2 // lvl["k"])
+        assert lvl["dispatches_per_trial_step"] == round(1 / lvl["k"], 4)
+        assert lvl["speedup_vs_k1"] > 0
+    assert r["k4_vs_k1"] is None  # no K=4 level in the shrunk sweep
+    assert "cpu_caveat" in r  # the virtual-device methodology caveat
+
+
+def test_flagship_cpu_history_parses_both_tail_forms(tmp_path, monkeypatch):
+    # Prior-round BENCH artifacts arrive in two shapes: a clean JSON
+    # line (r02-r04 era; no flagship_passes -> top-level value, implicit
+    # chunk 100) and a front-truncated tail where only the
+    # flagship_passes object survives (r05 era). Both must parse; a
+    # TPU round and a garbage file must not.
+    monkeypatch.chdir(tmp_path)
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "tail": json.dumps({
+            "metric": "vae_train_samples_per_sec_per_chip",
+            "value": 26519.5, "detail": {"platform": "cpu"},
+        }) + "\n",
+    }))
+    (tmp_path / "BENCH_r05.json").write_text(json.dumps({
+        "tail": ', "mfu": null, "detail": {"platform": "cpu", '
+                '"device_kind": "cpu", "flagship_passes": '
+                '{"samples_per_sec_per_chip": 23158.8, "chunk_steps": 100}}}',
+    }))
+    (tmp_path / "BENCH_r07.json").write_text(json.dumps({
+        "tail": json.dumps({
+            "value": 12e6, "detail": {"platform": "tpu"},
+        }) + "\n",
+    }))
+    (tmp_path / "BENCH_r08.json").write_text("not json at all")
+    hist = bench._flagship_cpu_history()
+    assert {(h["samples_per_sec_per_chip"], h["chunk_steps"]) for h in hist} \
+        == {(26519.5, 100), (23158.8, 100)}
+
+
+def test_drift_flag_fires_on_seeded_slowdown():
+    history = [
+        {"file": "BENCH_r02.json", "samples_per_sec_per_chip": 26000.0,
+         "chunk_steps": 100},
+        {"file": "BENCH_r03.json", "samples_per_sec_per_chip": 22600.0,
+         "chunk_steps": 100},
+        {"file": "BENCH_r04.json", "samples_per_sec_per_chip": 22250.0,
+         "chunk_steps": 100},
+        # different shape: must NOT enter the same-shape comparison
+        {"file": "BENCH_rX.json", "samples_per_sec_per_chip": 5.0,
+         "chunk_steps": 1},
+    ]
+    # seeded ~35% slowdown vs the chunk-100 median (22600)
+    slow = bench._drift_vs_prev_rounds(22600.0 * 0.65, 100, history)
+    assert slow["drift_exceeds_20pct"] is True
+    assert slow["median_prior"] == 22600.0
+    assert len(slow["prior_rounds"]) == 3  # chunk-1 round excluded
+    # in-band move: no flag
+    ok = bench._drift_vs_prev_rounds(22600.0 * 1.1, 100, history)
+    assert ok["drift_exceeds_20pct"] is False
+    # no same-shape priors -> no block at all
+    assert bench._drift_vs_prev_rounds(100.0, 777, history) is None
+
+
 def test_last_tpu_artifact_selection(tmp_path, monkeypatch):
     # Picks the newest real-TPU payload, skips CPU-fallback artifacts,
     # strips triage blobs, and marks the result stale with provenance.
@@ -151,11 +221,11 @@ def test_bench_suite_checkpoints_each_section(monkeypatch):
     # A wedged tunnel HANGS mid-suite; sections already captured must
     # have hit the checkpoint before any later section can block.
     for name in ("bench_kernel_smoke", "bench_ours", "bench_to_elbo",
-                 "bench_loader"):
+                 "bench_loader", "bench_stacked"):
         monkeypatch.setattr(bench, name, lambda *a, **k: {"ok": 1})
     calls = []
     r = bench.bench_suite(lambda partial: calls.append(set(partial)))
-    assert len(calls) == 7  # one checkpoint per section
+    assert len(calls) == 8  # one checkpoint per section
     assert calls[0] == {"kernel_smoke"}  # cheapest evidence banks first
     assert calls[-1] == set(r)
     # A failing checkpoint must never kill the capture itself.

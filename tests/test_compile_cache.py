@@ -32,7 +32,23 @@ def test_default_dir_anchors_at_checkout_root(monkeypatch):
     assert d == os.path.join(pkg_root, ".jax_cache")
 
 
-def test_enable_sets_config_and_creates_dir(tmp_path):
+def test_enable_is_noop_without_optin(tmp_path, monkeypatch):
+    # Default-off on this toolchain: deserialized XLA:CPU executables
+    # corrupt the heap on the pinned jaxlib (module docstring — the
+    # seed suite's test_hpo resume segfault), so without the explicit
+    # opt-in the switch must change NOTHING.
+    monkeypatch.delenv("MDT_FORCE_COMPILE_CACHE", raising=False)
+    target = str(tmp_path / "cache")
+    prev = jax.config.jax_compilation_cache_dir
+    assert enable_persistent_compile_cache(target) is False
+    assert not os.path.exists(target)
+    assert jax.config.jax_compilation_cache_dir == prev
+
+
+def test_enable_sets_config_and_creates_dir(tmp_path, monkeypatch):
+    # Opt-in path (a jaxlib whose CPU executable serialization is
+    # sound): the original behavior, behind MDT_FORCE_COMPILE_CACHE=1.
+    monkeypatch.setenv("MDT_FORCE_COMPILE_CACHE", "1")
     target = str(tmp_path / "cache")
     prev = jax.config.jax_compilation_cache_dir
     try:
@@ -43,9 +59,10 @@ def test_enable_sets_config_and_creates_dir(tmp_path):
         jax.config.update("jax_compilation_cache_dir", prev)
 
 
-def test_enable_is_best_effort_on_bad_dir(tmp_path):
+def test_enable_is_best_effort_on_bad_dir(tmp_path, monkeypatch):
     # A path that cannot be a directory must return False and leave the
     # config untouched — the cache is an optimization, never a failure.
+    monkeypatch.setenv("MDT_FORCE_COMPILE_CACHE", "1")
     blocker = tmp_path / "file"
     blocker.write_text("x")
     prev = jax.config.jax_compilation_cache_dir

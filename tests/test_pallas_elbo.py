@@ -138,6 +138,49 @@ def test_bf16_inputs_match_plain(arrays):
         )
 
 
+def test_bf16_multi_block_accumulator(monkeypatch):
+    # The round-4 hardware failure ("Invalid dtype for `swap`: Ref
+    # float32 vs value bfloat16", BENCH_r05.json's embedded r4 payload)
+    # lived in the fwd kernel's SMEM accumulator when bf16 operands
+    # crossed a multi-block grid — the one path the earlier bf16 test
+    # (single block) and multi-block test (f32) each missed. Interpret
+    # mode can't reproduce Mosaic's swap dtype check, so this pins the
+    # code-level contract instead: bf16 inputs + shrunken VMEM budget
+    # force the grid>1 accumulate store, and values must still match the
+    # plain path (the explicit .astype(out_ref.dtype) casts keep the
+    # stored dtype equal to the ref dtype by construction — the same
+    # program Mosaic compiles; bench_kernel_smoke banks the hardware
+    # proof each TPU window).
+    from multidisttorch_tpu.ops import pallas_elbo
+
+    monkeypatch.setattr(pallas_elbo, "_VMEM_BUDGET_BYTES", 64 * 1024)
+    rng = np.random.default_rng(11)
+    b, d, lat = 96, 784, 20
+    logits = jnp.asarray(rng.normal(0, 2, (b, d)), jnp.bfloat16)
+    x = jnp.asarray(rng.uniform(0, 1, (b, d)).astype(np.float32))
+    mu = jnp.asarray(rng.normal(0, 1, (b, lat)), jnp.bfloat16)
+    logvar = jnp.asarray(rng.normal(0, 0.5, (b, lat)), jnp.bfloat16)
+    assert pallas_elbo._block_rows(logits, x, mu, logvar) < b  # grid > 1
+
+    fused = float(fused_elbo_loss_sum(logits, x, mu, logvar, 1.0))
+    plain = float(
+        elbo_loss_sum(
+            logits.astype(jnp.float32), x,
+            mu.astype(jnp.float32), logvar.astype(jnp.float32), 1.0,
+        )
+    )
+    assert fused == pytest.approx(plain, rel=1e-5)
+
+    g_fused = jax.grad(
+        lambda l, m, lv: fused_elbo_loss_sum(l, x, m, lv, 1.0),
+        argnums=(0, 1, 2),
+    )(logits, mu, logvar)
+    for got, primal in zip(g_fused, (logits, mu, logvar)):
+        # cotangents come back at each primal's own storage dtype
+        assert got.dtype == primal.dtype
+        assert bool(jnp.all(jnp.isfinite(got.astype(jnp.float32))))
+
+
 def test_works_under_jit_and_scaling(arrays):
     logits, x, mu, logvar = arrays
 

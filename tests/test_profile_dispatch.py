@@ -1,0 +1,30 @@
+"""tools/profile_dispatch.py protocol tests: the round-6 fields that
+keep compile and device backpressure out of the dispatch percentiles
+(docs/DISPATCH.md — the round-5 level-1 p99 anomaly's fix)."""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+
+import profile_dispatch as pd  # noqa: E402
+
+
+def test_measure_separates_compile_and_backpressure(monkeypatch):
+    monkeypatch.setattr(pd, "CHUNK_STEPS", 2)
+    r = pd.measure(1, rounds=3, trace_dir=None, queue_depth=1)
+    # the attribution fields the r6 protocol promises
+    assert {"compile_s", "backpressure_s_total", "queue_depth",
+            "dispatch_ms_p50", "dispatch_ms_p99",
+            "host_dispatch_share_of_wall",
+            "backpressure_share_of_wall"} <= set(r)
+    assert r["queue_depth"] == 1
+    assert r["compile_s"] > 0  # compile happened, outside the window
+    assert r["dispatches"] == 3
+    assert r["backpressure_s_total"] >= 0
+    # shares are fractions of the same wall clock
+    assert 0 <= r["host_dispatch_share_of_wall"] <= 1.05
+    assert 0 <= r["backpressure_share_of_wall"] <= 1.05
+    assert r["samples_per_sec_per_trial"] > 0
