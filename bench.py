@@ -1488,6 +1488,13 @@ def main():
         "trial-stacking mode's banked evidence",
     )
     parser.add_argument(
+        "--chaos", action="store_true",
+        help="run the standard fault schedule (faults/harness.py) "
+        "against run_hpo supervision: recovery of every injected infra "
+        "fault, goodput (useful/executed steps), and bit-parity of "
+        "recovered trials vs the fault-free sweep",
+    )
+    parser.add_argument(
         "--suite", action="store_true",
         help="bank every measurement (flagship, fused-loss comparison, "
         "LM, to-elbo, loader) in one process — for one-shot windows on "
@@ -1497,11 +1504,13 @@ def main():
 
     if sum(x is not None and x is not False
            for x in (args.concurrency, args.to_elbo, args.loader,
-                     args.lm, args.suite, args.decode, args.stacked)) > 1:
+                     args.lm, args.suite, args.decode, args.stacked,
+                     args.chaos)) > 1:
         parser.error("--concurrency/--to-elbo/--loader/--lm/--decode/"
-                     "--suite/--stacked are mutually exclusive")
+                     "--suite/--stacked/--chaos are mutually exclusive")
 
-    if args.stacked and "xla_force_host_platform_device_count" not in (
+    if (args.stacked or args.chaos) and \
+            "xla_force_host_platform_device_count" not in (
         os.environ.get("XLA_FLAGS", "")
     ):
         # The stacked protocol measures PACKING — 8 pending trials at K
@@ -1642,6 +1651,33 @@ def main():
                     ),
                     "unit": "samples/sec",
                     "vs_baseline": tl.get("native_vs_python"),
+                    "detail": r,
+                }
+            )
+        )
+        return
+
+    if args.chaos:
+        import tempfile
+
+        from multidisttorch_tpu.faults.harness import run_chaos_bench
+
+        r = run_chaos_bench(tempfile.mkdtemp(prefix="bench_chaos_"))
+        r["backend"] = backend
+        print(
+            json.dumps(
+                {
+                    "metric": "chaos_goodput_useful_over_executed_steps",
+                    "value": r["goodput"],
+                    "unit": "fraction",
+                    # acceptance floor: goodput >= 0.8 of fault-free
+                    "vs_baseline": round(r["goodput"] / 0.8, 3),
+                    "all_infra_faults_recovered": r[
+                        "all_infra_faults_recovered"
+                    ],
+                    "final_metrics_bit_identical": r[
+                        "final_metrics_bit_identical"
+                    ],
                     "detail": r,
                 }
             )

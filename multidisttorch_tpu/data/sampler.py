@@ -19,7 +19,7 @@ SURVEY.md §2d:
 
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import Callable, Iterator, Optional
 
 import jax
 import numpy as np
@@ -62,6 +62,7 @@ class TrialDataIterator:
         num_trials: Optional[int] = None,
         with_labels: bool = False,
         use_native: Optional[bool] = None,
+        fault_hook: Optional[Callable[[int, int], None]] = None,
     ):
         if batch_size % trial.data_size != 0:
             raise ValueError(
@@ -74,6 +75,13 @@ class TrialDataIterator:
         self.batch_size = batch_size
         self.seed = seed
         self.with_labels = with_labels
+        # Fault-injection seam (faults/inject.py via the HPO driver):
+        # called as fault_hook(epoch, batch_index) right before each
+        # host batch is yielded — the exact point a real loader fault
+        # (bad shard, dead filesystem) surfaces. May raise; both the
+        # numpy and native paths pass through it, so chaos drills cover
+        # whichever loader the sweep actually runs.
+        self.fault_hook = fault_hook
         if shard_across_trials:
             # Legacy Q1 semantics: trial g sees rows [g::num_trials].
             if num_trials is None:
@@ -140,8 +148,10 @@ class TrialDataIterator:
             )
             try:
                 n = gatherer.start_epoch(perm, self.batch_size)
-                for _ in range(n):
+                for b in range(n):
                     imgs_np, labels_np = gatherer.next_batch()
+                    if self.fault_hook is not None:
+                        self.fault_hook(epoch, b)
                     yield imgs_np, (labels_np if self.with_labels else None)
             finally:
                 gatherer.close()
@@ -149,6 +159,8 @@ class TrialDataIterator:
 
         for b in range(self.num_batches):
             idx = perm[b * self.batch_size : (b + 1) * self.batch_size]
+            if self.fault_hook is not None:
+                self.fault_hook(epoch, b)
             yield self.dataset.images[idx], (
                 self.dataset.labels[idx] if self.with_labels else None
             )
@@ -292,6 +304,7 @@ class StackedTrialDataIterator:
         seeds: list[int],
         *,
         use_native: Optional[bool] = None,
+        fault_hook: Optional[Callable] = None,
     ):
         if batch_size % trial.data_size != 0:
             raise ValueError(
@@ -315,6 +328,12 @@ class StackedTrialDataIterator:
         # permutation — identical seeding to TrialDataIterator, which is
         # the whole parity contract.
         self._lanes = [{"seed": s, "epoch": 1} for s in seeds]
+        # Fault-injection seam: fault_hook(batch_index, stacked_np) ->
+        # stacked_np runs on each assembled (K, B, ...) host array —
+        # lane-targeted NaN poisoning for stacked divergence drills
+        # (the vmapped program keeps lanes independent, so a poisoned
+        # lane diverges alone). Must preserve shape/dtype.
+        self.fault_hook = fault_hook
         self._use_native = False
         if use_native is not False:
             from multidisttorch_tpu.data import native
@@ -374,14 +393,20 @@ class StackedTrialDataIterator:
             g = StackedBatchGatherer(self.dataset.images)
             try:
                 n = g.start_round(perms, bs)
-                for _ in range(n):
-                    yield g.next_stacked()
+                for b in range(n):
+                    stacked = g.next_stacked()
+                    if self.fault_hook is not None:
+                        stacked = self.fault_hook(b, stacked)
+                    yield stacked
             finally:
                 g.close()
         else:
             for b in range(self.num_batches):
                 idx = perms[:, b * bs : (b + 1) * bs].reshape(-1)
-                yield self.dataset.images[idx].reshape(k, bs, -1)
+                stacked = self.dataset.images[idx].reshape(k, bs, -1)
+                if self.fault_hook is not None:
+                    stacked = self.fault_hook(b, stacked)
+                yield stacked
         self._advance_epochs()
 
     def round_batches(self) -> Iterator:

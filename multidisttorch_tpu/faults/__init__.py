@@ -1,0 +1,31 @@
+"""Deterministic fault injection for chaos-testing trial supervision.
+
+``plan`` defines the serializable schedule (:class:`FaultPlan` /
+:class:`FaultSpec`); ``inject`` interprets it at run time
+(:class:`FaultInjector`) through hooks the HPO driver threads through
+itself, the step dispatch, and the data iterators; ``harness`` runs the
+standard chaos protocol behind ``bench.py --chaos`` and
+``tools/chaos_run.py``. See docs/RESILIENCE.md for the failure taxonomy
+and how to write a plan.
+"""
+
+from multidisttorch_tpu.faults.plan import (  # noqa: F401
+    ALL_KINDS,
+    CKPT_CORRUPT,
+    CRASH,
+    DATA_ERROR,
+    DIVERGE,
+    INFRA_KINDS,
+    PREEMPT,
+    SLOW,
+    FaultPlan,
+    FaultSpec,
+)
+from multidisttorch_tpu.faults.inject import (  # noqa: F401
+    DataFault,
+    FaultInjector,
+    HostPreemption,
+    InfraFault,
+    InjectedCrash,
+    corrupt_file,
+)

@@ -1,0 +1,242 @@
+"""The standard chaos protocol behind ``bench.py --chaos`` and
+``tools/chaos_run.py``.
+
+Runs the SAME small sweep twice — once clean, once under
+:meth:`FaultPlan.standard` with full supervision (retry + ledger +
+scan-back restore + driver restart on preemption) — and reports:
+
+- **recovery**: every infra fault in the plan fired and the sweep still
+  settled every trial (completed, or diverged where the plan injected
+  divergence);
+- **goodput**: useful optimizer steps / executed optimizer steps across
+  all attempts (fault-free ≡ 1.0). Step-based, not wall-clock-based, so
+  the metric measures the *recovery machinery's* overhead — replayed
+  epochs, from-scratch lane restarts — rather than CPU recompile noise
+  that would swamp a tiny CI-sized model;
+- **parity**: for every trial whose faults hit between checkpoints
+  (everything except the injected divergence), the final train loss is
+  bit-identical to the fault-free run — resume-and-replay is exact.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import asdict
+
+from multidisttorch_tpu.faults.inject import FaultInjector, HostPreemption
+from multidisttorch_tpu.faults.plan import DIVERGE, FaultPlan
+
+MAX_RESTARTS = 8  # driver restarts on preemption; plan-bounded in practice
+
+
+def standard_configs(trials: int = 6, epochs: int = 4) -> list:
+    """The chaos sweep's trial set: tiny VAEs (CI-sized), distinct
+    lr/seed per trial so results are distinguishable, quiet logging."""
+    from multidisttorch_tpu.hpo.driver import TrialConfig
+
+    return [
+        TrialConfig(
+            trial_id=i,
+            epochs=epochs,
+            batch_size=16,
+            hidden_dim=32,
+            latent_dim=8,
+            lr=1e-3 + 1e-4 * i,
+            seed=i,
+            log_interval=10_000,
+        )
+        for i in range(trials)
+    ]
+
+
+def _sweep_kwargs(out_dir: str) -> dict:
+    return dict(
+        num_groups=2,
+        out_dir=out_dir,
+        verbose=False,
+        save_images=False,
+    )
+
+
+def run_chaos_bench(
+    work_dir: str,
+    *,
+    trials: int = 6,
+    epochs: int = 4,
+    seed: int = 0,
+    include_preempt: bool = True,
+    data_rows: int = 128,
+    stacked: bool = False,
+    plan: "FaultPlan | None" = None,
+) -> dict:
+    """Execute the standard fault schedule and return the report dict.
+
+    ``stacked=True`` runs the chaos sweep in trial-stacking mode
+    (lane-recovery drill: 2 groups, K lanes each) — preemption is
+    excluded there (a stacked sweep cannot resume, so the restart
+    protocol doesn't apply; the unstacked run is the restart drill).
+
+    ``plan`` drills a custom :class:`FaultPlan` verbatim instead of the
+    standard schedule (its ``trial_id``s must reference this sweep's
+    trials, ``0..trials-1``); the report's recovery/parity/goodput math
+    is identical, but the 0.8 goodput acceptance is the STANDARD
+    schedule's contract — custom-plan callers decide their own bar.
+    """
+    import os
+    import shutil
+
+    from multidisttorch_tpu.data.datasets import synthetic_mnist
+    from multidisttorch_tpu.hpo.driver import run_hpo
+    from multidisttorch_tpu.hpo.ledger import SweepLedger
+    from multidisttorch_tpu.hpo.supervision import RetryPolicy
+
+    configs = standard_configs(trials, epochs)
+    train = synthetic_mnist(data_rows, seed=0)
+    steps_per_epoch = data_rows // configs[0].batch_size
+
+    # --- fault-free reference ---------------------------------------
+    # Fresh sweep dirs: a stale ledger/checkpoint set from a previous
+    # bench invocation would contaminate the restart protocol.
+    ff_dir = os.path.join(work_dir, "fault_free")
+    for d in (ff_dir, os.path.join(work_dir, "chaos")):
+        shutil.rmtree(d, ignore_errors=True)
+    t0 = time.time()
+    ff_results = run_hpo(
+        configs, train, None, **_sweep_kwargs(ff_dir),
+        ledger=False, stack_trials=stacked,
+    )
+    wall_ff = time.time() - t0
+    ff_loss = {r.trial_id: r.final_train_loss for r in ff_results}
+
+    # --- chaos run --------------------------------------------------
+    custom_plan = plan is not None
+    if plan is None:
+        plan = FaultPlan.standard(
+            [c.trial_id for c in configs],
+            seed=seed,
+            steps_per_epoch=steps_per_epoch,
+            include_preempt=include_preempt and not stacked,
+        )
+    injector = FaultInjector(plan)
+    chaos_dir = os.path.join(work_dir, "chaos")
+    retry = RetryPolicy(max_retries=2, backoff_base_s=0.01)
+    restarts = 0
+    t0 = time.time()
+    while True:
+        try:
+            results = run_hpo(
+                configs, train, None, **_sweep_kwargs(chaos_dir),
+                resilient=True,
+                retry=retry,
+                fault_plan=injector,
+                resume=restarts > 0,
+                ckpt_keep_last=2,
+                stack_trials=stacked,
+            )
+            break
+        except HostPreemption:
+            # The simulated host died mid-sweep. A real deployment
+            # restarts the driver process; here the restart reuses the
+            # injector (fired faults stay fired) and the on-disk ledger
+            # + checkpoints do the rest.
+            restarts += 1
+            if restarts > MAX_RESTARTS:
+                raise RuntimeError(
+                    f"chaos harness: >{MAX_RESTARTS} preemption restarts "
+                    "— the plan should bound preemptions; supervision is "
+                    "not converging"
+                )
+    wall_chaos = time.time() - t0
+
+    # --- accounting -------------------------------------------------
+    by_id = {r.trial_id: r for r in results}
+    diverge_targets = {
+        s.trial_id for s in plan.specs if s.kind == DIVERGE
+    }
+    # Useful = work embodied in a SETTLED outcome (completed weights or
+    # a terminal divergence verdict). A terminally-failed trial's steps
+    # are executed-but-wasted: they appear in the denominator via its
+    # ledger progress records, never in the numerator.
+    useful_steps = sum(
+        r.steps
+        for r in results
+        if r.status in ("completed", "resumed_complete", "diverged")
+    )
+    executed_steps = _executed_steps(SweepLedger(chaos_dir), useful=results)
+    goodput = useful_steps / executed_steps if executed_steps else 0.0
+
+    recovered, parity = [], []
+    for cfg in configs:
+        r = by_id[cfg.trial_id]
+        if cfg.trial_id in diverge_targets:
+            recovered.append(
+                {"trial_id": cfg.trial_id, "expected": "diverged",
+                 "status": r.status, "ok": r.status == "diverged"}
+            )
+            continue
+        bit_identical = r.final_train_loss == ff_loss[cfg.trial_id]
+        recovered.append(
+            {"trial_id": cfg.trial_id, "expected": "completed",
+             "status": r.status,
+             "ok": r.status in ("completed", "resumed_complete")}
+        )
+        parity.append(
+            {"trial_id": cfg.trial_id, "attempts": r.attempt,
+             "chaos_loss": r.final_train_loss,
+             "fault_free_loss": ff_loss[cfg.trial_id],
+             "bit_identical": bit_identical}
+        )
+
+    all_recovered = all(x["ok"] for x in recovered)
+    all_parity = all(x["bit_identical"] for x in parity)
+    return {
+        "protocol": (
+            ("chaos_custom_plan_v1" if custom_plan else "chaos_standard_v1")
+            + ("_stacked" if stacked else "")
+        ),
+        "custom_plan": custom_plan,
+        "plan": {"seed": plan.seed, "specs": [asdict(s) for s in plan.specs]},
+        "faults_fired": list(injector.fired),
+        "restarts_after_preemption": restarts,
+        "trials": trials,
+        "epochs": epochs,
+        "steps_per_epoch": steps_per_epoch,
+        "useful_steps": useful_steps,
+        "executed_steps": executed_steps,
+        "goodput": round(goodput, 4),
+        "wall_fault_free_s": round(wall_ff, 3),
+        "wall_chaos_s": round(wall_chaos, 3),
+        "wall_ratio": round(wall_ff / wall_chaos, 4) if wall_chaos else None,
+        "recovered": recovered,
+        "all_infra_faults_recovered": all_recovered,
+        "final_metrics_bit_identical": all_parity,
+        "parity": parity,
+        "statuses": {r.trial_id: r.status for r in results},
+    }
+
+
+def _executed_steps(ledger, useful) -> int:
+    """Total optimizer steps executed across every attempt: each
+    attempt's (end step − resume step), summed — settled final attempts
+    from the results themselves, failed/interrupted attempts from their
+    ledger progress records. Terminally-failed results are excluded from
+    the result-side sum (their final attempt's work arrives via the
+    'failed' event's progress summary; counting the result too would
+    double-count it, and its steps are wasted work, not useful)."""
+    total = sum(
+        max(0, r.steps - r.resumed_from_step)
+        for r in useful
+        if r.status in ("completed", "resumed_complete", "diverged")
+    )
+    for ev in ledger.load():
+        if ev.get("event") != "attempt_end":
+            continue
+        if ev.get("status") not in ("retrying", "preempted", "failed"):
+            continue
+        s = ev.get("summary") or {}
+        total += max(
+            0,
+            int(s.get("steps_at_failure", 0))
+            - int(s.get("resumed_from_step", 0)),
+        )
+    return total

@@ -1,0 +1,220 @@
+"""Fault injection runtime: the hooks the driver threads through itself.
+
+A :class:`FaultInjector` interprets a :class:`~.plan.FaultPlan` at run
+time. It is pure host-side bookkeeping — no compiled program ever
+changes shape because of it (the DIVERGE kind poisons a *batch*, so the
+NaN flows through the normal compiled step; nothing recompiles). Every
+fired fault is recorded in :attr:`FaultInjector.fired` for the chaos
+report's recovery accounting.
+
+Hook sites (threaded by ``hpo/driver.py``):
+
+- :meth:`step_hook` — before each train-step dispatch (per trial, per
+  optimizer step): CRASH raises, PREEMPT raises, SLOW sleeps.
+- :meth:`poison_batch` — wraps the step's host/device batch when a
+  DIVERGE fault covers any step in the dispatch (``train.steps.
+  wrap_step_with_hooks`` applies it).
+- :meth:`data_hook` — inside the trial's data iterator
+  (``data.sampler``): DATA_ERROR raises mid-epoch, where a real loader
+  fault (bad shard, dead filesystem) would.
+- :meth:`checkpoint_hook` — after an epoch checkpoint write lands:
+  CKPT_CORRUPT garbles the state file in place, exactly the torn/rotted
+  artifact ``restore_latest_valid`` must scan past.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+from multidisttorch_tpu.faults.plan import (
+    CKPT_CORRUPT,
+    CRASH,
+    DATA_ERROR,
+    DIVERGE,
+    PREEMPT,
+    SLOW,
+    FaultPlan,
+    FaultSpec,
+)
+
+
+class InfraFault(RuntimeError):
+    """Base of injected *infrastructure* failures — the retryable class."""
+
+
+class InjectedCrash(InfraFault):
+    """A worker raised mid-trial (the generic injected exception)."""
+
+
+class HostPreemption(InfraFault):
+    """Simulated host preemption. The driver does NOT absorb this into a
+    per-trial failure: it propagates out of ``run_hpo`` (the 'driver
+    died' half of the chaos protocol) and the harness restarts the sweep
+    against the ledger."""
+
+
+class DataFault(InfraFault):
+    """The trial's data iterator failed mid-epoch."""
+
+
+class FaultInjector:
+    """Stateful interpreter of one :class:`FaultPlan` over one sweep.
+
+    Single-threaded by design (the driver's scheduling loop is); fire
+    counts persist across trial retries — with the default
+    ``max_fires=1`` a retried trial passes the injection point cleanly,
+    modeling a transient fault.
+    """
+
+    def __init__(self, plan: FaultPlan):
+        import threading
+
+        self.plan = plan
+        self._fires: dict[int, int] = {}  # spec index -> times fired
+        self.fired: list[dict] = []  # chronological record, for reports
+        # The driver's scheduling loop is single-threaded, but the
+        # checkpoint hook fires from the background writer thread —
+        # bookkeeping mutations take this lock.
+        self._lock = threading.Lock()
+
+    # -- bookkeeping -------------------------------------------------
+
+    def _due(self, spec_index: int, spec: FaultSpec) -> bool:
+        return self._fires.get(spec_index, 0) < spec.max_fires
+
+    def _record(self, spec_index: int, spec: FaultSpec, **ctx) -> None:
+        with self._lock:
+            self._fires[spec_index] = self._fires.get(spec_index, 0) + 1
+            self.fired.append(
+                {"kind": spec.kind, "trial_id": spec.trial_id, **ctx,
+                 "ts": time.time()}
+            )
+
+    def _match(
+        self,
+        kinds,
+        trial_id: int,
+        *,
+        step=None,
+        n_steps: int = 1,
+        **field_eq,
+    ):
+        """First due spec in PLAN ORDER whose kind is in ``kinds``, for
+        ``trial_id``, whose ``spec.step`` falls in the dispatch window
+        ``[step, step + n_steps)`` (when ``step`` given) and whose other
+        fields equal ``field_eq``. The single matching scan every hook
+        routes through — one copy of the window/dueness semantics."""
+        for idx, spec in enumerate(self.plan.specs):
+            if spec.kind not in kinds or spec.trial_id != trial_id:
+                continue
+            if not self._due(idx, spec):
+                continue
+            if step is not None and not (
+                step <= spec.step < step + n_steps
+            ):
+                continue
+            if not all(getattr(spec, k) == v for k, v in field_eq.items()):
+                continue
+            return idx, spec
+        return None
+
+    # -- hook sites --------------------------------------------------
+    # All `fired` records carry step=spec.step — the fault's scheduled
+    # point, not the dispatch-window start — so reports read uniformly.
+
+    def step_hook(self, trial_id: int, step: int, n_steps: int = 1) -> None:
+        """Called before dispatching ``n_steps`` optimizer steps starting
+        at ``step`` for ``trial_id``. Raises for CRASH/PREEMPT whose
+        step falls in the window; sleeps for SLOW (and keeps scanning —
+        a straggler stall does not shadow a crash in the same window)."""
+        while True:
+            m = self._match(
+                (CRASH, PREEMPT, SLOW), trial_id, step=step, n_steps=n_steps
+            )
+            if m is None:
+                return
+            idx, spec = m
+            self._record(idx, spec, step=spec.step)
+            if spec.kind == SLOW:
+                time.sleep(spec.delay_s)
+                continue
+            if spec.kind == CRASH:
+                raise InjectedCrash(
+                    f"injected crash: trial {trial_id} at step {spec.step}"
+                )
+            raise HostPreemption(
+                f"injected preemption: host lost while trial "
+                f"{trial_id} was at step {spec.step}"
+            )
+
+    def diverge_covers(self, trial_id: int, step: int, n_steps: int = 1) -> bool:
+        """Whether a DIVERGE fault is due inside the dispatch window."""
+        return (
+            self._match((DIVERGE,), trial_id, step=step, n_steps=n_steps)
+            is not None
+        )
+
+    def poison_batch(
+        self, trial_id: int, step: int, batch, n_steps: int = 1
+    ):
+        """NaN-fill the batch (or, in a ``(K, B, ...)`` fused chunk, the
+        exact covered inner-step slice) feeding a DIVERGE-covered
+        dispatch. The loss then goes non-finite through the *real*
+        compiled program — detection and terminal classification are
+        exercised end-to-end, not simulated.
+
+        Host-side: materializes the operand as numpy (single-controller
+        territory, like the chaos harness itself)."""
+        m = self._match((DIVERGE,), trial_id, step=step, n_steps=n_steps)
+        if m is None:
+            return batch
+        idx, spec = m
+        self._record(idx, spec, step=spec.step)
+        arr = np.array(batch, copy=True)
+        if n_steps == 1:
+            arr[...] = np.nan
+        else:
+            arr[spec.step - step] = np.nan
+        return arr
+
+    def data_hook(self, trial_id: int, step: int, n_steps: int = 1) -> None:
+        """Called by the data iterator as it assembles the batch(es) for
+        the dispatch starting at ``step``."""
+        m = self._match((DATA_ERROR,), trial_id, step=step, n_steps=n_steps)
+        if m is not None:
+            idx, spec = m
+            self._record(idx, spec, step=spec.step)
+            raise DataFault(
+                f"injected data-iterator failure: trial {trial_id} "
+                f"at step {spec.step}"
+            )
+
+    def checkpoint_hook(
+        self, trial_id: int, epoch: int, path: str
+    ) -> Optional[str]:
+        """Called after the epoch-``epoch`` checkpoint write for
+        ``trial_id`` lands at ``path``. CKPT_CORRUPT overwrites the
+        file's tail with garbage — a torn/rotted artifact whose CRC
+        sidecar no longer matches. Returns the corrupted path (or None)."""
+        m = self._match((CKPT_CORRUPT,), trial_id, epoch=epoch)
+        if m is None:
+            return None
+        idx, spec = m
+        self._record(idx, spec, epoch=epoch, path=path)
+        corrupt_file(path)
+        return path
+
+
+def corrupt_file(path: str, *, keep_bytes: Optional[int] = None) -> None:
+    """Garble a file in place: keep the first half (or ``keep_bytes``),
+    replace the rest with 0xFF — the shape of a torn write or partial
+    flush. Deterministic, so chaos runs are reproducible."""
+    size = os.path.getsize(path)
+    keep = size // 2 if keep_bytes is None else min(keep_bytes, size)
+    with open(path, "r+b") as f:
+        f.seek(keep)
+        f.write(b"\xff" * (size - keep))

@@ -56,9 +56,24 @@ from multidisttorch_tpu.data.sampler import (
     StackedTrialDataIterator,
     TrialDataIterator,
 )
+from multidisttorch_tpu.hpo.ledger import SweepLedger, config_hash
+from multidisttorch_tpu.hpo.supervision import (
+    DIVERGENCE,
+    FATAL,
+    INFRA,
+    PREEMPTION,
+    RetryPolicy,
+    UnretryableError,
+    classify_failure,
+)
 from multidisttorch_tpu.models.vae import VAE
 from multidisttorch_tpu.parallel.mesh import TrialMesh, setup_groups
-from multidisttorch_tpu.train.checkpoint import restore_state, save_state
+from multidisttorch_tpu.train.checkpoint import (
+    restore_latest_valid,
+    restore_state,
+    save_state,
+)
+from multidisttorch_tpu.train.guards import DivergenceError, check_finite
 from multidisttorch_tpu.train.steps import (
     TrialHypers,
     build_lane_state,
@@ -73,6 +88,7 @@ from multidisttorch_tpu.train.steps import (
     make_stacked_train_step,
     make_train_step,
     state_shardings,
+    wrap_step_with_hooks,
 )
 from multidisttorch_tpu.utils.imaging import save_image_grid
 from multidisttorch_tpu.utils.logging import log0
@@ -128,8 +144,18 @@ class TrialResult:
     steps: int = 0
     out_dir: str = ""
     checkpoint: str = ""
-    status: str = "completed"  # "completed" | "failed" | "resumed_complete"
+    # "completed" | "failed" | "resumed_complete" | "diverged"
+    # ("diverged" = non-finite loss: a terminal RESULT of the config,
+    # recorded and never retried — see hpo/supervision.py)
+    status: str = "completed"
     error: str = ""
+    # Which attempt produced this result (1 = first try; >1 means the
+    # supervisor retried infra faults — the ledger holds the history).
+    attempt: int = 1
+    # Optimizer step this attempt resumed from (0 = scratch): the
+    # difference steps - resumed_from_step is the attempt's EXECUTED
+    # work — what the chaos bench's goodput accounting sums.
+    resumed_from_step: int = 0
     # Data provenance: which dataset the trial actually trained on, and
     # whether it was the synthetic zero-egress stand-in. The reference
     # always trains on real MNIST (vae-hpo.py:133-144); this repo can
@@ -147,6 +173,52 @@ class TrialResult:
     # (docs/STACKING.md): K same-shape trials vmapped through one
     # compiled program on one submesh.
     stacked: bool = False
+
+
+def _result_summary(result: TrialResult) -> dict:
+    """The ledger's attempt_end payload: enough to reconstruct a
+    TrialResult when a restarted sweep skips the trial entirely."""
+    return {
+        "group_id": result.group_id,
+        "history": list(result.history),
+        "final_train_loss": result.final_train_loss,
+        "final_test_loss": result.final_test_loss,
+        "wall_s": result.wall_s,
+        "steps": result.steps,
+        "out_dir": result.out_dir,
+        "checkpoint": result.checkpoint,
+        "dataset": result.dataset,
+        "dataset_synthetic": result.dataset_synthetic,
+        "stacked": result.stacked,
+        "resumed_from_step": result.resumed_from_step,
+    }
+
+
+def _result_from_summary(
+    cfg: TrialConfig, rec: dict, status: str
+) -> TrialResult:
+    """Rebuild a TrialResult from a ledger attempt_end record (the
+    restarted-sweep skip path — no state is touched)."""
+    s = rec.get("summary") or {}
+    return TrialResult(
+        trial_id=cfg.trial_id,
+        group_id=int(s.get("group_id", -1)),
+        config=cfg,
+        history=list(s.get("history", [])),
+        final_train_loss=float(s.get("final_train_loss", float("nan"))),
+        final_test_loss=float(s.get("final_test_loss", float("nan"))),
+        wall_s=float(s.get("wall_s", 0.0)),
+        steps=int(s.get("steps", 0)),
+        out_dir=s.get("out_dir", ""),
+        checkpoint=s.get("checkpoint", ""),
+        status=status,
+        error=rec.get("error", ""),
+        dataset=s.get("dataset", ""),
+        dataset_synthetic=bool(s.get("dataset_synthetic", False)),
+        stacked=bool(s.get("stacked", False)),
+        attempt=int(rec.get("attempt", 1)),
+        resumed_from_step=int(s.get("resumed_from_step", 0)),
+    )
 
 
 class _TrialRun:
@@ -176,8 +248,11 @@ class _TrialRun:
         verbose: bool = True,
         model_builder=None,
         param_shardings_builder=None,
-        resume: bool = False,
+        resume=False,  # False | True (strict) | "scan" (supervised)
         agree_failures: bool = False,
+        agree_timeout_s: Optional[float] = None,
+        injector=None,  # faults.inject.FaultInjector | None
+        ckpt_keep_last: int = 1,
     ):
         if cfg.fused_steps < 1:
             raise ValueError(
@@ -218,8 +293,19 @@ class _TrialRun:
         # trial identically instead of one process freeing the group
         # while peers keep stepping it.
         self._agree = agree_failures
+        self._agree_timeout_s = agree_timeout_s
         self._deferred_error: Optional[BaseException] = None
         self._host_syncs = 0
+        # Fault-injection seams (None in production): chaos drills route
+        # through the SAME dispatch/data/checkpoint paths real faults
+        # take — see faults/inject.py for the hook contract.
+        self._injector = injector
+        self._ckpt_keep_last = ckpt_keep_last
+        # Optimizer-step cursor mirrored as an attribute so the
+        # injection hooks (closures built below, called from inside the
+        # compiled-step wrappers) always see the current step.
+        self._step_no = 0
+        self._epoch_base_step = 0
 
         if model_builder is None:
             model = VAE(hidden_dim=cfg.hidden_dim, latent_dim=cfg.latent_dim)
@@ -265,6 +351,29 @@ class _TrialRun:
             if cfg.fused_steps > 1
             else None
         )
+        if injector is not None:
+            # Thread the chaos hooks through the step dispatch: a
+            # single-step dispatch covers 1 optimizer step, a fused
+            # chunk covers its leading dim. The wrappers are pure host
+            # code — no recompilation, no shape change.
+            tid = cfg.trial_id
+            self.train_step = wrap_step_with_hooks(
+                self.train_step,
+                before=lambda b: injector.step_hook(tid, self._step_no, 1),
+                transform_batch=lambda b: injector.poison_batch(
+                    tid, self._step_no, b, 1
+                ),
+            )
+            if self.multi_step is not None:
+                self.multi_step = wrap_step_with_hooks(
+                    self.multi_step,
+                    before=lambda b: injector.step_hook(
+                        tid, self._step_no, b.shape[0]
+                    ),
+                    transform_batch=lambda b: injector.poison_batch(
+                        tid, self._step_no, b, b.shape[0]
+                    ),
+                )
         # Reconstructions are materialized (and all-gathered back to
         # replicated) only when images are wanted. Keyed on the uniform
         # save_images argument, NOT the per-process writer-gated flag:
@@ -288,6 +397,9 @@ class _TrialRun:
             seed=cfg.seed,
             shard_across_trials=shard_across_trials,
             num_trials=num_trials,
+            fault_hook=(
+                None if injector is None else self._data_fault_hook
+            ),
         )
         # Full-coverage eval (reference parity, vae-hpo.py:101-105): the
         # pad-and-mask iterator consumes every test row — including test
@@ -308,7 +420,33 @@ class _TrialRun:
         self._ckpt_thread: Optional[threading.Thread] = None
         self._ckpt_error: Optional[BaseException] = None
         self._start_epoch = 1
-        if resume:
+        if resume == "scan":
+            # Supervised retry-with-resume: scan back past torn/corrupt
+            # checkpoints to the newest VALID one whose recorded config
+            # matches (train/checkpoint.py's CRC machinery); nothing
+            # valid means retry from scratch. No strict errors here —
+            # the supervisor's contract is "recover the most work
+            # possible", not "diagnose for a human".
+            got = restore_latest_valid(
+                self.state,
+                self._ckpt_path,
+                trial,
+                shardings=self._state_sh,
+                accept_meta=lambda meta: not self._config_mismatch(meta),
+            )
+            if got is not None:
+                restored, meta, used = got
+                done = int(meta.get("completed_epochs", 0))
+                if done >= 1:
+                    self.state = restored
+                    self._start_epoch = done + 1
+                    self._adopt_history(meta)
+                    log0(
+                        f"Trial {cfg.trial_id} retry resumes from epoch "
+                        f"{done} checkpoint ({used})",
+                        trial=trial,
+                    )
+        elif resume:
             meta_path = self._ckpt_path + ".json"
             if os.path.exists(self._ckpt_path) and os.path.exists(meta_path):
                 with open(meta_path) as f:
@@ -316,30 +454,10 @@ class _TrialRun:
                 # Guard against resuming under silently-changed
                 # hyperparameters: everything except the epoch target
                 # (extending epochs is the legitimate resume use) must
-                # match the checkpoint's saved config. Fields absent
-                # from an older checkpoint's sidecar compare against
-                # their TrialConfig default — a checkpoint written
-                # before a field existed was trained under its default.
-                from dataclasses import MISSING, fields as dc_fields
-
-                field_defaults = {
-                    f.name: f.default
-                    for f in dc_fields(TrialConfig)
-                    if f.default is not MISSING
-                }
-                saved = {
-                    k: meta.get(k, field_defaults.get(k))
-                    for k in asdict(cfg)
-                    if k != "epochs" and (k in meta or k in field_defaults)
-                }
-                current = {k: v for k, v in asdict(cfg).items() if k != "epochs"}
-                if saved and saved != current:
-                    diff = {
-                        k: (saved.get(k), current[k])
-                        for k in current
-                        if saved.get(k) != current[k]
-                    }
-                    raise ValueError(
+                # match the checkpoint's saved config.
+                diff = self._config_mismatch(meta)
+                if diff:
+                    raise UnretryableError(
                         f"resume: trial {cfg.trial_id} checkpoint at "
                         f"{self._ckpt_path} was written under different "
                         f"hyperparameters {diff} (saved vs current); "
@@ -354,7 +472,7 @@ class _TrialRun:
                     )
                     restored_step = int(jax.device_get(self.state.step))
                     if "step" in meta and restored_step != int(meta["step"]):
-                        raise ValueError(
+                        raise UnretryableError(
                             f"resume: trial {cfg.trial_id} checkpoint is "
                             f"skewed — state.msgpack is at optimizer step "
                             f"{restored_step} but the metadata sidecar "
@@ -366,15 +484,60 @@ class _TrialRun:
                             "an already-applied epoch"
                         )
                     self._start_epoch = done + 1
-                    self.result.history = list(meta.get("history", []))
-                    if self.result.history:
-                        last = self.result.history[-1]
-                        self.result.final_train_loss = last.get(
-                            "avg_train_loss", float("nan")
-                        )
-                        self.result.final_test_loss = last.get(
-                            "test_loss", float("nan")
-                        )
+                    self._adopt_history(meta)
+        # Executed-work accounting (chaos goodput): what step this
+        # attempt starts from. Epoch data order is drop-tail-stable, so
+        # the resume step is exactly epochs-done x batches-per-epoch.
+        self.result.resumed_from_step = (
+            (self._start_epoch - 1) * self.train_iter.num_batches
+        )
+
+    def _config_mismatch(self, meta: dict) -> dict:
+        """Fields (epochs excluded — extending epochs is the legitimate
+        resume use) where the checkpoint's recorded config differs from
+        the current one; empty dict = match. Fields absent from an older
+        checkpoint's sidecar compare against their TrialConfig default —
+        a checkpoint written before a field existed was trained under
+        its default."""
+        from dataclasses import MISSING, fields as dc_fields
+
+        cfg = self.cfg
+        field_defaults = {
+            f.name: f.default
+            for f in dc_fields(TrialConfig)
+            if f.default is not MISSING
+        }
+        saved = {
+            k: meta.get(k, field_defaults.get(k))
+            for k in asdict(cfg)
+            if k != "epochs" and (k in meta or k in field_defaults)
+        }
+        current = {k: v for k, v in asdict(cfg).items() if k != "epochs"}
+        if not saved or saved == current:
+            return {}
+        return {
+            k: (saved.get(k), current[k])
+            for k in current
+            if saved.get(k) != current[k]
+        }
+
+    def _adopt_history(self, meta: dict) -> None:
+        self.result.history = list(meta.get("history", []))
+        if self.result.history:
+            last = self.result.history[-1]
+            self.result.final_train_loss = last.get(
+                "avg_train_loss", float("nan")
+            )
+            self.result.final_test_loss = last.get(
+                "test_loss", float("nan")
+            )
+
+    def _data_fault_hook(self, epoch: int, batch_index: int) -> None:
+        """Data-iterator injection seam: maps the iterator's
+        (epoch, batch_index) to the trial's global optimizer step."""
+        self._injector.data_hook(
+            self.cfg.trial_id, self._epoch_base_step + batch_index
+        )
 
     def _log(self, *args):
         if self._verbose:
@@ -411,7 +574,19 @@ class _TrialRun:
         from multidisttorch_tpu.parallel.collectives import group_all_ok
 
         err, self._deferred_error = self._deferred_error, None
-        if not group_all_ok(self.trial, err is None):
+        # Deadline-bounded: a dead peer owner would otherwise hang this
+        # reduction forever (the reference's exact lost-rank behavior).
+        # On expiry the TimeoutError propagates through the trial's
+        # normal failure isolation, naming the trial and boundary.
+        if not group_all_ok(
+            self.trial,
+            err is None,
+            timeout_s=self._agree_timeout_s,
+            what=(
+                f"trial {self.cfg.trial_id} {where} health agreement "
+                f"over submesh group {self.trial.group_id}"
+            ),
+        ):
             if err is not None:
                 raise err
             raise RuntimeError(
@@ -426,8 +601,22 @@ class _TrialRun:
         next :meth:`_join_ckpt` and flow through the trial's normal
         failure isolation."""
         try:
-            save_state(host_state, self._ckpt_path, metadata=meta)
+            save_state(
+                host_state,
+                self._ckpt_path,
+                metadata=meta,
+                keep_last=self._ckpt_keep_last,
+            )
             self.result.checkpoint = self._ckpt_path
+            if self._injector is not None:
+                # Chaos seam: CKPT_CORRUPT garbles the file AFTER the
+                # write lands — the bit-rot/torn artifact that
+                # restore_latest_valid must scan past on retry.
+                self._injector.checkpoint_hook(
+                    self.cfg.trial_id,
+                    int(meta.get("completed_epochs", 0)),
+                    self._ckpt_path,
+                )
         except BaseException as e:  # re-raised at the next join
             self._ckpt_error = e
 
@@ -454,9 +643,11 @@ class _TrialRun:
             return
         n_per_epoch = self.train_iter.samples_per_epoch
         # state.step counts optimizer updates, so it doubles as the
-        # resume-safe global step for RNG folding.
-        step_no = int(jax.device_get(self.state.step))
+        # resume-safe global step for RNG folding. Kept as an attribute:
+        # the fault-injection hook closures read it mid-dispatch.
+        self._step_no = int(jax.device_get(self.state.step))
         for epoch in range(self._start_epoch, cfg.epochs + 1):
+            self._epoch_base_step = self._step_no
             # On-device loss accumulation (mirrors the eval path below):
             # each batch's contribution is an async device add; the
             # single float() at the epoch boundary is the train loop's
@@ -482,11 +673,11 @@ class _TrialRun:
 
             if self.multi_step is None:
                 for i, batch in enumerate(self.train_iter.epoch(epoch)):
-                    rng = jax.random.fold_in(self._key, step_no)
+                    rng = jax.random.fold_in(self._key, self._step_no)
                     self.state, metrics = self.train_step(
                         self.state, batch, rng
                     )
-                    step_no += 1
+                    self._step_no += 1
                     s = metrics["loss_sum"]  # on device, async
                     epoch_sum_dev = s if epoch_sum_dev is None else epoch_sum_dev + s
                     if i % cfg.log_interval == 0:
@@ -502,11 +693,11 @@ class _TrialRun:
                     i0, chunk = item[0], item[1]
                     c = chunk.shape[0]
                     if c == K:
-                        rng = jax.random.fold_in(self._key, step_no)
+                        rng = jax.random.fold_in(self._key, self._step_no)
                         self.state, metrics = self.multi_step(
                             self.state, chunk, rng
                         )
-                        step_no += c
+                        self._step_no += c
                         losses = metrics["loss_sum"]  # (K,) on device
                         s = losses.sum()  # device add, async
                         epoch_sum_dev = (
@@ -523,11 +714,11 @@ class _TrialRun:
                         # Tail shorter than the compiled chunk: step it
                         # batch-by-batch (no extra compilation).
                         for j in range(c):
-                            rng = jax.random.fold_in(self._key, step_no)
+                            rng = jax.random.fold_in(self._key, self._step_no)
                             self.state, metrics = self.train_step(
                                 self.state, chunk[j], rng
                             )
-                            step_no += 1
+                            self._step_no += 1
                             s = metrics["loss_sum"]
                             epoch_sum_dev = (
                                 s
@@ -541,6 +732,17 @@ class _TrialRun:
             # One fetch for the whole epoch's average (O(1)-syncs rule).
             self._host_syncs += 1
             avg = float(epoch_sum_dev) / n_per_epoch
+            # Divergence gate at the sync the loop already pays: a
+            # non-finite epoch average is a terminal trial RESULT
+            # (deterministic training replays the same NaN on retry) —
+            # raised before the checkpoint write below so NaN weights
+            # are never persisted over a valid checkpoint.
+            check_finite(
+                avg,
+                "epoch average train loss",
+                step=self._step_no,
+                trial_id=cfg.trial_id,
+            )
             self._log(
                 "====> Epoch: {} Average loss: {:.4f}".format(epoch, avg)
             )
@@ -679,7 +881,7 @@ class _TrialRun:
         with self._guard():
             self._join_ckpt()
         self.result.wall_s = time.time() - t0
-        self.result.steps = step_no
+        self.result.steps = self._step_no
         self.result.host_syncs = self._host_syncs
         if self._is_writer:
             with self._guard():
@@ -759,6 +961,12 @@ class _StackedBucketRun:
         max_lanes: int = 8,
         save_checkpoint: bool = True,
         verbose: bool = True,
+        injector=None,  # faults.inject.FaultInjector | None
+        retry: Optional[RetryPolicy] = None,
+        ledger: Optional[SweepLedger] = None,
+        attempts: Optional[dict] = None,  # config index -> attempts started
+        chashes: Optional[dict] = None,  # config index -> config hash
+        infra_fails: Optional[dict] = None,  # config index -> infra failures
     ):
         template = items[0][1]
         for _, cfg in items:
@@ -775,6 +983,20 @@ class _StackedBucketRun:
         self._verbose = verbose
         self._host_syncs = 0
         self._is_writer = trial.is_writer_process
+        # Lane supervision (docs/RESILIENCE.md): a faulted lane is
+        # retired through the SAME mask-and-refill machinery finished
+        # lanes use — the other K-1 lanes never stop. Retried lanes
+        # restart from scratch (stacked lanes checkpoint only at
+        # retirement, so there is no mid-trial checkpoint to resume;
+        # the bucket queue's natural serialization stands in for
+        # backoff).
+        self._injector = injector
+        self._retry = retry
+        self._ledger = ledger
+        self._attempts = attempts if attempts is not None else {}
+        self._chashes = chashes if chashes is not None else {}
+        self._infra_fails = infra_fails if infra_fails is not None else {}
+        self._round_step0: dict[int, int] = {}
 
         self.model = VAE(
             hidden_dim=template.hidden_dim, latent_dim=template.latent_dim
@@ -790,9 +1012,14 @@ class _StackedBucketRun:
         self.lanes: list[Optional[dict]] = [
             self._fresh_lane(i, cfg) for i, cfg in first
         ]
+        for lane in self.lanes:
+            self._note_attempt_start(lane)
         self.data = StackedTrialDataIterator(
             train_data, trial, self.batch_size,
             seeds=[lane["cfg"].seed for lane in self.lanes],
+            fault_hook=(
+                None if injector is None else self._stacked_fault_hook
+            ),
         )
         self.test_iter = (
             EvalDataIterator(test_data, trial, self.batch_size)
@@ -864,6 +1091,194 @@ class _StackedBucketRun:
             if lane is not None:
                 lane["steps"] += n
 
+    # -- lane supervision (chaos/retry support) ----------------------
+
+    def _note_attempt_start(self, lane: dict) -> None:
+        idx = lane["idx"]
+        self._attempts[idx] = self._attempts.get(idx, 0) + 1
+        if self._ledger is not None:
+            self._ledger.attempt_start(
+                lane["cfg"].trial_id,
+                self._chashes.get(idx, ""),
+                self._attempts[idx],
+            )
+
+    def _note_attempt_end(
+        self, lane: dict, status: str, *, error: str = "", summary=None
+    ) -> None:
+        if self._ledger is not None:
+            idx = lane["idx"]
+            self._ledger.attempt_end(
+                lane["cfg"].trial_id,
+                self._chashes.get(idx, ""),
+                self._attempts.get(idx, 1),
+                status,
+                error=error,
+                summary=summary,
+            )
+
+    def lane_progress(self, idx: int) -> Optional[dict]:
+        """Executed-work progress for config index ``idx`` if it is
+        currently riding a live lane (stacked lanes always start from
+        scratch, so resumed_from is 0 by construction)."""
+        for lane in self.lanes:
+            if lane is not None and lane["idx"] == idx:
+                return {
+                    "resumed_from_step": 0,
+                    "steps_at_failure": lane["steps"],
+                }
+        return None
+
+    def record_preempted(self, error_text: str) -> None:
+        """Ledger 'preempted' events for every live lane — called when a
+        preemption elsewhere in the sweep kills the driver (and this
+        bucket with it)."""
+        for lane in self.lanes:
+            if lane is not None:
+                self._note_attempt_end(
+                    lane, "preempted", error=error_text,
+                    summary=self.lane_progress(lane["idx"]),
+                )
+
+    def _stacked_fault_hook(self, batch_index: int, stacked):
+        """Poison a DIVERGE-covered lane's slice of the (K, B, ...) host
+        batch: the NaN flows through that lane only (the vmapped program
+        keeps lanes independent), so exactly one trial diverges."""
+        out = stacked
+        for k, lane in enumerate(self.lanes):
+            if lane is None:
+                continue
+            tid = lane["cfg"].trial_id
+            step = self._round_step0.get(k, lane["steps"]) + batch_index
+            if self._injector.diverge_covers(tid, step):
+                if out is stacked:
+                    out = np.array(stacked, copy=True)
+                out[k] = self._injector.poison_batch(tid, step, out[k])
+        return out
+
+    def _round_start_faults(self) -> None:
+        """Fire lane-scoped infra faults due inside the coming round.
+
+        A faulted lane is retired and refilled through the same
+        mask-and-refill path finished lanes take — the other K-1 lanes
+        keep training in the same compiled program. HostPreemption is
+        NOT lane-scoped (the host is going away): it propagates and
+        fails the bucket, as a real preemption would.
+        """
+        if self._injector is None:
+            return
+        from multidisttorch_tpu.faults.inject import (
+            HostPreemption,
+            InfraFault,
+        )
+
+        round_len = self.data.num_batches
+        k = 0
+        while k < len(self.lanes):
+            lane = self.lanes[k]
+            if lane is None:
+                k += 1
+                continue
+            tid = lane["cfg"].trial_id
+            try:
+                self._injector.step_hook(tid, lane["steps"], round_len)
+                self._injector.data_hook(tid, lane["steps"], round_len)
+            except HostPreemption:
+                raise
+            except InfraFault as e:
+                self._fault_lane(k, e)
+                # Re-scan lane k WITHOUT advancing: the refill occupant
+                # is about to run its own first round, and its faults
+                # due in [0, round_len) must fire now, not be skipped.
+                # Bounded: max_fires caps firings, the retry budget
+                # caps requeues, so the queue drains.
+                continue
+            k += 1
+
+    def _fault_lane(self, k: int, exc: BaseException) -> None:
+        """Infra fault scoped to one lane: retire it (no result capture
+        — its weights are suspect), requeue per the retry budget, and
+        refill the lane from the bucket queue."""
+        lane = self.lanes[k]
+        idx, cfg = lane["idx"], lane["cfg"]
+        error_text = f"{type(exc).__name__}: {exc}"
+        fails = self._infra_fails[idx] = self._infra_fails.get(idx, 0) + 1
+        progress = {"resumed_from_step": 0, "steps_at_failure": lane["steps"]}
+        if self._retry is not None and self._retry.should_retry(fails, INFRA):
+            self._note_attempt_end(
+                lane, "retrying", error=error_text, summary=progress
+            )
+            # Retry from scratch at the queue's tail: stacked lanes
+            # checkpoint only at retirement, and the queue's natural
+            # serialization stands in for backoff.
+            self.queue.append((idx, cfg))
+            self._log(
+                f"Trial {cfg.trial_id} lane {k} FAULTED ({error_text}); "
+                f"lane retired, trial requeued (infra failure {fails}), "
+                f"{sum(l is not None for l in self.lanes) - 1} lanes "
+                "continue"
+            )
+        else:
+            result = TrialResult(
+                trial_id=cfg.trial_id,
+                group_id=self.trial.group_id,
+                config=cfg,
+                out_dir=os.path.join(self.out_dir, f"trial-{cfg.trial_id}"),
+                status="failed",
+                error=error_text,
+                dataset=self._train_name,
+                dataset_synthetic=self._train_synthetic,
+                stacked=True,
+                attempt=self._attempts.get(idx, 1),
+            )
+            self.results[idx] = result
+            self._note_attempt_end(
+                lane, "failed", error=error_text, summary=progress
+            )
+            self._log(
+                f"Trial {cfg.trial_id} lane {k} FAILED ({error_text}); "
+                "retry budget exhausted, lane freed"
+            )
+        self._refill_or_mask(k)
+
+    def _diverge_lane(self, k: int, avg: float) -> None:
+        """Terminal divergence scoped to one lane: record the result
+        (never retried — the config reproduces its own NaN) and refill."""
+        lane = self.lanes[k]
+        idx, cfg = lane["idx"], lane["cfg"]
+        err = DivergenceError(
+            "lane epoch average train loss",
+            avg,
+            step=lane["steps"],
+            trial_id=cfg.trial_id,
+        )
+        result = TrialResult(
+            trial_id=cfg.trial_id,
+            group_id=self.trial.group_id,
+            config=cfg,
+            history=list(lane["history"]),
+            out_dir=os.path.join(self.out_dir, f"trial-{cfg.trial_id}"),
+            steps=lane["steps"],
+            wall_s=time.time() - lane["t0"],
+            host_syncs=self._host_syncs - lane["syncs0"],
+            status="diverged",
+            error=str(err),
+            dataset=self._train_name,
+            dataset_synthetic=self._train_synthetic,
+            stacked=True,
+            attempt=self._attempts.get(idx, 1),
+        )
+        self.results[idx] = result
+        self._note_attempt_end(
+            lane, "diverged", error=str(err),
+            summary=_result_summary(result),
+        )
+        self._log(
+            f"Trial {cfg.trial_id} DIVERGED (stacked lane {k}, "
+            f"non-finite loss at step {lane['steps']}); lane freed"
+        )
+        self._refill_or_mask(k)
+
     def _retire(self, k: int) -> None:
         """Capture lane k's result + checkpoint, then refill or mask."""
         lane = self.lanes[k]
@@ -921,15 +1336,26 @@ class _StackedBucketRun:
                     f,
                     indent=2,
                 )
+        result.attempt = self._attempts.get(lane["idx"], 1)
         self.results[lane["idx"]] = result
+        self._note_attempt_end(
+            lane, "completed", summary=_result_summary(result)
+        )
         self._log(
             f"Trial {cfg.trial_id} done (stacked lane {k}). "
             f"time: {result.wall_s:f}"
         )
+        self._refill_or_mask(k)
 
+    def _refill_or_mask(self, k: int) -> None:
+        """The mask-and-refill tail shared by retirement, lane faults,
+        and lane divergence: pop the next queued config into lane ``k``
+        (a compiled dynamic-index write — no recompilation), or mask the
+        lane inactive when the queue is dry."""
         if self.queue:
             idx, nxt = self.queue.pop(0)
             self.lanes[k] = self._fresh_lane(idx, nxt)
+            self._note_attempt_start(self.lanes[k])
             self.state = self.write_lane(
                 self.state,
                 self.trial.device_put(build_lane_state(self.model, nxt.seed)),
@@ -956,6 +1382,20 @@ class _StackedBucketRun:
     def run(self) -> Iterator[None]:
         n_per_epoch = self.data.samples_per_epoch
         while any(lane is not None for lane in self.lanes):
+            # Lane-scoped infra faults due this round fire BEFORE the
+            # round dispatches: the faulted lane retires and refills,
+            # the others never notice.
+            self._round_start_faults()
+            if not any(lane is not None for lane in self.lanes):
+                break
+            # Per-lane step counts at round start: the data fault hook
+            # maps (lane, batch index) -> global optimizer step with
+            # these (lane["steps"] itself advances mid-round).
+            self._round_step0 = {
+                k: lane["steps"]
+                for k, lane in enumerate(self.lanes)
+                if lane is not None
+            }
             round_sum_dev = None  # (K,) on-device
 
             def add(dev_sums):
@@ -1017,11 +1457,18 @@ class _StackedBucketRun:
                 test_sums = np.asarray(test_dev)
 
             retiring = []
+            diverged = []
             for k, lane in enumerate(self.lanes):
                 if lane is None:
                     continue
                 lane["epochs_done"] += 1
                 avg = float(train_sums[k]) / n_per_epoch
+                if not np.isfinite(avg):
+                    # Terminal divergence, scoped to this lane — the
+                    # vmapped program kept the NaN out of its
+                    # neighbors (per-lane params/optimizer/losses).
+                    diverged.append(k)
+                    continue
                 record = {"epoch": lane["epochs_done"], "avg_train_loss": avg}
                 self._log(
                     "Trial {} ====> Epoch: {} Average loss: {:.4f}".format(
@@ -1039,6 +1486,9 @@ class _StackedBucketRun:
                 lane["history"].append(record)
                 if lane["epochs_done"] >= lane["cfg"].epochs:
                     retiring.append(k)
+            for k in diverged:
+                self._diverge_lane(k, float(train_sums[k]) / n_per_epoch)
+                yield
             for k in retiring:
                 self._retire(k)
                 yield
@@ -1065,6 +1515,11 @@ def run_hpo(
     profile_dir: Optional[str] = None,
     stack_trials: bool = False,
     stack_max_lanes: int = 8,
+    retry: Optional[RetryPolicy] = None,
+    fault_plan=None,
+    ledger: bool = True,
+    ckpt_keep_last: int = 1,
+    agree_timeout_s: Optional[float] = None,
 ) -> list[TrialResult]:
     """Run the configs over disjoint submeshes, concurrently, with no
     cross-trial synchronization.
@@ -1127,6 +1582,38 @@ def run_hpo(
     different sweep; ``save_images`` is ignored for stacked buckets
     (no reconstruction/sample grids — run image trials unstacked).
 
+    **Trial supervision** (docs/RESILIENCE.md): ``retry=RetryPolicy()``
+    turns infra-class failures (worker exceptions, data-iterator faults,
+    checkpoint I/O — ``hpo/supervision.py``'s classification) into
+    supervised retries with capped exponential backoff; each retry
+    resumes from the trial's last *valid* checkpoint
+    (``train.checkpoint.restore_latest_valid`` scans back past torn or
+    corrupt files), falling back to scratch when none survives. A
+    non-finite loss is classified as **divergence** — a terminal trial
+    result (``status="diverged"``, recorded, never retried, never
+    raised: deterministic training replays the same NaN). A
+    ``HostPreemption`` always propagates out of ``run_hpo`` — per-trial
+    retry is meaningless when the host is going away; restart the driver
+    instead. In stacked mode a faulted lane is retired and refilled
+    through the mask-and-refill machinery (the other K-1 lanes never
+    stop); retried lanes restart from scratch.
+
+    ``ledger=True`` (default) appends every attempt's config hash and
+    outcome to ``{out_dir}/sweep_ledger.jsonl`` (crash-safe JSONL,
+    ``hpo/ledger.py``); with ``resume=True`` a killed-and-restarted
+    ``run_hpo`` skips trials the ledger settled (completed/diverged
+    under a byte-identical config) and re-runs only unfinished ones —
+    the driver itself is preemption-safe.
+
+    ``fault_plan`` (a ``faults.FaultPlan`` or ``FaultInjector``) arms
+    deterministic chaos injection through the driver/step/data/
+    checkpoint hook seams — CI-grade recovery drills, see
+    ``tools/chaos_run.py``. ``ckpt_keep_last=K`` retains K checkpoint
+    generations per trial (scan-back depth for retry-with-resume).
+    ``agree_timeout_s`` bounds every multi-host health agreement so a
+    dead peer produces a diagnosable ``TimeoutError`` instead of an
+    indefinite hang (default: ``MDT_AGREE_TIMEOUT_S`` env, else 600 s).
+
     Returns results for locally-run trials, in config order.
     """
     if profile_dir is not None:
@@ -1156,6 +1643,11 @@ def run_hpo(
             resume=resume,
             stack_trials=stack_trials,
             stack_max_lanes=stack_max_lanes,
+            retry=retry,
+            fault_plan=fault_plan,
+            ledger=ledger,
+            ckpt_keep_last=ckpt_keep_last,
+            agree_timeout_s=agree_timeout_s,
         )
 
 
@@ -1212,6 +1704,11 @@ def _run_hpo_body(
     resume,
     stack_trials=False,
     stack_max_lanes=8,
+    retry=None,
+    fault_plan=None,
+    ledger=True,
+    ckpt_keep_last=1,
+    agree_timeout_s=None,
 ) -> list[TrialResult]:
     if groups is None:
         groups = setup_groups(
@@ -1251,7 +1748,88 @@ def _run_hpo_body(
     def needs_agreement(g: TrialMesh) -> bool:
         return resilient and jax.process_count() > 1 and g.spans_processes
 
-    def make_run(trial: TrialMesh, cfg: TrialConfig) -> _TrialRun:
+    # --- trial supervision state (docs/RESILIENCE.md) ---------------
+    injector = None
+    if fault_plan is not None:
+        from multidisttorch_tpu.faults.inject import FaultInjector
+        from multidisttorch_tpu.faults.plan import FaultPlan
+
+        if isinstance(fault_plan, FaultInjector):
+            injector = fault_plan
+        elif isinstance(fault_plan, FaultPlan):
+            injector = FaultInjector(fault_plan)
+        else:
+            raise TypeError(
+                f"fault_plan must be a FaultPlan or FaultInjector, got "
+                f"{type(fault_plan).__name__}"
+            )
+        if jax.process_count() > 1:
+            from multidisttorch_tpu.faults.plan import DIVERGE
+
+            if any(s.kind == DIVERGE for s in injector.plan.specs):
+                raise ValueError(
+                    "fault_plan: DIVERGE injection is single-controller "
+                    "only — the poison hook materializes the step's "
+                    "batch host-side, which a process-spanning sharded "
+                    "array cannot do. Drill divergence in a "
+                    "single-process run; the other fault kinds work "
+                    "multi-controller."
+                )
+    if agree_timeout_s is None:
+        from multidisttorch_tpu.parallel.cluster import _env_timeout
+
+        agree_timeout_s = _env_timeout("MDT_AGREE_TIMEOUT_S", 600.0)
+    # The sweep's durable control state: every attempt's config hash and
+    # outcome. Writes are fsync'd JSONL appends (crash = at most one
+    # torn, skipped line); only process 0 writes, every process reads
+    # (skip decisions must be identical everywhere).
+    chashes = {i: config_hash(asdict(cfg)) for i, cfg in enumerate(configs)}
+    led = SweepLedger(
+        out_dir, enabled=ledger, write=jax.process_index() == 0
+    )
+    prior_attempts = led.attempts() if led.enabled else {}
+    attempts: dict[int, int] = {
+        i: prior_attempts.get(chashes[i], 0) for i in range(len(configs))
+    }
+    # Retry budget bookkeeping is by infra FAILURE, not by attempt:
+    # attempts also grow on preemption restarts, which must not eat the
+    # budget (RetryPolicy.should_retry's contract).
+    prior_fails = led.infra_failures() if led.enabled else {}
+    infra_fails: dict[int, int] = {
+        i: prior_fails.get(chashes[i], 0) for i in range(len(configs))
+    }
+    # Stacked-bucket SETUP failures are whole-bucket events (no lane
+    # exists yet to attribute them to); their retry budget is counted
+    # per bucket, keyed by the member-index tuple.
+    bucket_setup_fails: dict[tuple, int] = {}
+
+    results: dict[int, TrialResult] = {}
+    skipped: set[int] = set()
+    if resume and led.enabled:
+        # Restart path: trials the ledger settled under a byte-identical
+        # config are reconstructed from their recorded summary and never
+        # scheduled — the driver re-runs only unfinished work.
+        settled = led.finished()
+        for i, cfg in enumerate(configs):
+            rec = settled.get(chashes[i])
+            if rec is None:
+                continue
+            status = (
+                "resumed_complete"
+                if rec.get("status") == "completed"
+                else "diverged"
+            )
+            results[i] = _result_from_summary(cfg, rec, status)
+            skipped.add(i)
+        if skipped:
+            log0(
+                f"sweep ledger: {len(skipped)} of {len(configs)} trials "
+                "already settled; re-running only the rest"
+            )
+
+    def make_run(
+        trial: TrialMesh, cfg: TrialConfig, resume_mode
+    ) -> _TrialRun:
         return _TrialRun(
             trial,
             cfg,
@@ -1269,8 +1847,11 @@ def _run_hpo_body(
             verbose=verbose,
             model_builder=model_builder,
             param_shardings_builder=param_shardings_builder,
-            resume=resume,
+            resume=resume_mode,
             agree_failures=needs_agreement(trial),
+            agree_timeout_s=agree_timeout_s,
+            injector=injector,
+            ckpt_keep_last=ckpt_keep_last,
         )
 
     # Queue configs per group. Single-controller: one shared queue,
@@ -1320,7 +1901,9 @@ def _run_hpo_body(
     # Stacking applies only when trials outnumber groups — otherwise
     # every trial gets its own submesh and stacking would only serialize.
     def build_items() -> list[tuple[str, list[tuple[int, TrialConfig]]]]:
-        indexed = list(enumerate(configs))
+        indexed = [
+            (i, cfg) for i, cfg in enumerate(configs) if i not in skipped
+        ]
         if not (stack_trials and len(configs) > len(groups)):
             return [("single", [item]) for item in indexed]
         buckets: dict[tuple, list] = {}
@@ -1356,7 +1939,11 @@ def _run_hpo_body(
         items.sort(key=lambda it: it[1][0][0])
         return items
 
-    shared = build_items()
+    # Queue items are (kind, members, ready_at): "single"/"retry" carry
+    # one (i, cfg); "bucket" carries the stacked members. ready_at > now
+    # = a retry still in its backoff window (skipped, not blocking —
+    # other queued work runs first).
+    shared = [(k, m, 0.0) for k, m in build_items()]
     per_group: dict[int, list] = {g.group_id: [] for g in groups}
     if not single:
         assignment = balanced_assignment(
@@ -1364,32 +1951,110 @@ def _run_hpo_body(
             len(groups),
         )
         for i, cfg in enumerate(configs):
+            if i in skipped:
+                continue
             per_group[groups[assignment[i]].group_id].append(
-                ("single", [(i, cfg)])
+                ("single", [(i, cfg)], 0.0)
             )
     queue_of = (
         (lambda g: shared) if single else (lambda g: per_group[g.group_id])
     )
 
     local_groups = [g for g in groups if g.is_local_member]
-    results: dict[int, TrialResult] = {}
     # group -> (kind, config_index_or_None, run, generator) in flight
     active: dict[int, tuple] = {}
 
-    def fail_items(g, members, error_text) -> None:
+    def fail_items(g, members, error_text, *, status="failed",
+                   progress_of=None) -> None:
         for i, cfg in members:
+            if attempts.get(i, 0) == 0:
+                # A member that never started (queued behind a bucket
+                # that broke): this failure IS its first attempt — pair
+                # a start with the end so the ledger's attempt history
+                # stays well-formed and attempt numbering stays 1-based.
+                attempts[i] = 1
+                led.attempt_start(cfg.trial_id, chashes[i], 1)
             results[i] = TrialResult(
                 trial_id=cfg.trial_id,
                 group_id=g.group_id,
                 config=cfg,
-                status="failed",
+                status=status,
                 error=error_text,
+                attempt=attempts[i],
             )
+            led.attempt_end(
+                cfg.trial_id, chashes[i], attempts[i],
+                status, error=error_text,
+                summary=progress_of(i) if progress_of is not None else None,
+            )
+
+    def attempt_progress(run: Optional[_TrialRun]) -> dict:
+        """Executed-work accounting for a failed/interrupted attempt
+        (the chaos bench's goodput input)."""
+        if run is None:
+            return {"resumed_from_step": 0, "steps_at_failure": 0}
+        return {
+            "resumed_from_step": run.result.resumed_from_step,
+            "steps_at_failure": run._step_no,
+        }
+
+    def schedule_retry(g: TrialMesh, i, cfg, error_text, progress=None) -> bool:
+        """Consume one unit of the infra retry budget; returns False
+        when the failure class or budget says the trial is done
+        retrying."""
+        if retry is None:
+            return False
+        fails = infra_fails[i] = infra_fails.get(i, 0) + 1
+        if not retry.should_retry(fails, INFRA):
+            return False
+        # Backoff deadlines are wall-clock and therefore PROCESS-LOCAL;
+        # on a spanning submesh every owner must make identical
+        # scheduling decisions without communicating, so multi-
+        # controller retries requeue immediately (FIFO order is shared
+        # state; clocks are not).
+        delay = retry.backoff_s(fails) if single else 0.0
+        led.attempt_end(
+            cfg.trial_id, chashes[i], attempts[i], "retrying",
+            error=error_text, summary=progress,
+        )
+        queue_of(g).append(("retry", [(i, cfg)], time.time() + delay))
+        log0(
+            f"Trial {cfg.trial_id} FAULTED ({error_text}); retrying from "
+            f"last valid checkpoint in {delay:.2f}s "
+            f"(infra failure {fails} of {retry.max_retries + 1} budget)",
+            trial=g,
+        )
+        return True
+
+    def record_preempted_peers() -> None:
+        """A preemption kills the whole driver, not one trial: every
+        other in-flight attempt (single runs AND stacked-bucket lanes)
+        dies with it. Record them all so restart accounting and the
+        chaos goodput math see the full picture."""
+        for _gid, (k2, i2, run2, _g2) in list(active.items()):
+            if k2 == "single":
+                led.attempt_end(
+                    run2.cfg.trial_id, chashes[i2], attempts[i2],
+                    "preempted", error="host preemption (sweep-wide)",
+                    summary=attempt_progress(run2),
+                )
+            else:
+                run2.record_preempted("host preemption (sweep-wide)")
+
+    def next_ready_at() -> Optional[float]:
+        queues = [shared] if single else [
+            per_group[g.group_id] for g in local_groups
+        ]
+        deadlines = [item[2] for q in queues for item in q]
+        return min(deadlines) if deadlines else None
 
     def start_next(g: TrialMesh) -> bool:
         q = queue_of(g)
-        while q:
-            kind, members = q.pop(0)
+        for _ in range(len(q)):
+            kind, members, ready_at = q.pop(0)
+            if ready_at > time.time():
+                q.append((kind, members, ready_at))  # backoff not over
+                continue
             if kind == "bucket":
                 try:
                     brun = _StackedBucketRun(
@@ -1397,9 +2062,48 @@ def _run_hpo_body(
                         max_lanes=stack_max_lanes,
                         save_checkpoint=save_checkpoints,
                         verbose=verbose,
+                        injector=injector,
+                        retry=retry,
+                        ledger=led,
+                        attempts=attempts,
+                        chashes=chashes,
+                        infra_fails=infra_fails,
                     )
                 except Exception as e:  # noqa: BLE001 — setup isolation
                     error_text = f"{type(e).__name__}: {e}"
+                    if classify_failure(e) == PREEMPTION:
+                        # The host (or a peer) is gone: even resilient
+                        # sweeps stop; the ledger sees every in-flight
+                        # attempt before the driver dies.
+                        fail_items(
+                            g, members, error_text, status="preempted"
+                        )
+                        record_preempted_peers()
+                        raise
+                    # Same contract as the single-trial setup path: a
+                    # transient infra fault (loader init, filesystem)
+                    # gets the retry budget before K trials are failed
+                    # permanently. Budget is per-bucket (no lane exists
+                    # yet to charge), requeued at the queue's tail.
+                    key = tuple(i for i, _ in members)
+                    fails = bucket_setup_fails[key] = (
+                        bucket_setup_fails.get(key, 0) + 1
+                    )
+                    if (
+                        retry is not None
+                        and classify_failure(e) == INFRA
+                        and retry.should_retry(fails, INFRA)
+                    ):
+                        delay = retry.backoff_s(fails) if single else 0.0
+                        q.append(("bucket", members, time.time() + delay))
+                        log0(
+                            f"Stacked bucket of {len(members)} trials "
+                            f"FAULTED at setup ({error_text}); retrying "
+                            f"in {delay:.2f}s (setup failure {fails} of "
+                            f"{retry.max_retries + 1} budget)",
+                            trial=g,
+                        )
+                        continue
                     fail_items(g, members, error_text)
                     if not resilient:
                         raise
@@ -1412,10 +2116,16 @@ def _run_hpo_body(
                 active[g.group_id] = ("bucket", None, brun, brun.run())
                 return True
             i, cfg = members[0]
+            attempts[i] += 1
+            led.attempt_start(cfg.trial_id, chashes[i], attempts[i])
+            # Retries resume via the scan-back path (tolerates the
+            # torn/corrupt checkpoints a fault may have left); first
+            # attempts keep the user-facing strict resume semantics.
+            resume_mode = "scan" if kind == "retry" else resume
             err: Optional[BaseException] = None
             run: Optional[_TrialRun] = None
             try:
-                run = make_run(g, cfg)
+                run = make_run(g, cfg, resume_mode)
             except Exception as e:  # noqa: BLE001 — setup failure isolation
                 err = e
             if needs_agreement(g):
@@ -1427,7 +2137,12 @@ def _run_hpo_body(
                     group_all_ok,
                 )
 
-                ok = group_all_ok(g, err is None)
+                ok = group_all_ok(
+                    g,
+                    err is None,
+                    timeout_s=agree_timeout_s,
+                    what=f"trial {cfg.trial_id} setup agreement",
+                )
             else:
                 ok = err is None
             if not ok:
@@ -1436,12 +2151,30 @@ def _run_hpo_body(
                     if err is not None
                     else "setup failed on a peer owner process"
                 )
+                # A broken setup (bad restore, dead data path) is an
+                # infra fault like any other: supervised sweeps retry it
+                # (the retry's scan-resume is what recovers a trial
+                # whose strict resume chokes on a corrupt checkpoint).
+                # FATAL setup errors — the strict-resume integrity
+                # guards (UnretryableError) — are the exception: they
+                # exist to stop for a human, and a scan-retry would
+                # retrain over the checkpoint the guard protected.
+                fatal = (
+                    err is not None and classify_failure(err) == FATAL
+                )
+                if not fatal and schedule_retry(g, i, cfg, error_text):
+                    continue
                 results[i] = TrialResult(
                     trial_id=cfg.trial_id,
                     group_id=g.group_id,
                     config=cfg,
                     status="failed",
                     error=error_text,
+                    attempt=attempts[i],
+                )
+                led.attempt_end(
+                    cfg.trial_id, chashes[i], attempts[i], "failed",
+                    error=error_text, summary=attempt_progress(run),
                 )
                 if not resilient:
                     if err is not None:
@@ -1464,8 +2197,19 @@ def _run_hpo_body(
     # stacked bucket — K trials per dispatch) per cycle. A finished (or
     # failed) item frees its submesh, which immediately starts its next
     # queued work — the sweep's wall-clock is bounded by real work,
-    # never by barriers (Q3 fixed).
-    while active:
+    # never by barriers (Q3 fixed). Retries waiting out their backoff
+    # never block live work; when ONLY backoff items remain, the loop
+    # sleeps to the earliest deadline.
+    while True:
+        for g in local_groups:
+            if g.group_id not in active:
+                start_next(g)  # a backoff retry may have matured
+        if not active:
+            deadline = next_ready_at()
+            if deadline is None:
+                break
+            time.sleep(max(0.0, deadline - time.time()) + 1e-3)
+            continue
         for g in local_groups:
             if g.group_id not in active:
                 continue
@@ -1476,19 +2220,42 @@ def _run_hpo_body(
                 if kind == "bucket":
                     results.update(run.results)
                 else:
+                    run.result.attempt = attempts[i]
                     results[i] = run.result
+                    led.attempt_end(
+                        run.cfg.trial_id, chashes[i], attempts[i],
+                        "completed", summary=_result_summary(run.result),
+                    )
                 del active[g.group_id]
                 start_next(g)
             except Exception as e:  # noqa: BLE001 — failure isolation
                 error_text = f"{type(e).__name__}: {e}"
+                failure_class = classify_failure(e)
                 if kind == "bucket":
                     # Lanes already retired keep their completed
                     # results; everything in flight or queued in the
                     # bucket fails together (they shared the broken
-                    # program/state).
+                    # program/state). Lane-scoped faults never reach
+                    # here — the bucket absorbs them via mask-and-
+                    # refill; this path is bucket-wide breakage.
                     results.update(run.results)
-                    fail_items(g, run.unfinished(), error_text)
+                    status = (
+                        "preempted"
+                        if failure_class == PREEMPTION
+                        else "failed"
+                    )
+                    fail_items(
+                        g, run.unfinished(), error_text, status=status,
+                        progress_of=(
+                            run.lane_progress
+                            if failure_class == PREEMPTION
+                            else None
+                        ),
+                    )
                     del active[g.group_id]
+                    if failure_class == PREEMPTION:
+                        record_preempted_peers()
+                        raise
                     if not resilient:
                         raise
                     log0(
@@ -1498,9 +2265,6 @@ def _run_hpo_body(
                     )
                     start_next(g)
                     continue
-                run.result.status = "failed"
-                run.result.error = error_text
-                results[i] = run.result
                 del active[g.group_id]
                 # Drain any in-flight checkpoint write before freeing the
                 # submesh: run_hpo must not return while a writer thread
@@ -1509,7 +2273,68 @@ def _run_hpo_body(
                 try:
                     run._join_ckpt()
                 except Exception as ce:  # noqa: BLE001
-                    run.result.error += f"; also: {type(ce).__name__}: {ce}"
+                    error_text += f"; also: {type(ce).__name__}: {ce}"
+                if failure_class == PREEMPTION:
+                    # The host is going away (or a peer already did, for
+                    # an agreement TimeoutError): no per-trial retry
+                    # makes sense, and even a resilient sweep must stop.
+                    # The ledger records EVERY in-flight attempt — the
+                    # raising trial and its still-running peers, single
+                    # runs and stacked lanes alike, since they all die
+                    # with the driver — so restart accounting and resume
+                    # decisions see the whole picture; a restarted
+                    # run_hpo(resume=True) re-runs only unfinished work.
+                    led.attempt_end(
+                        run.cfg.trial_id, chashes[i], attempts[i],
+                        "preempted", error=error_text,
+                        summary=attempt_progress(run),
+                    )
+                    record_preempted_peers()
+                    raise
+                if failure_class == DIVERGENCE:
+                    # Terminal RESULT, not an error: the config drove
+                    # training to a non-finite loss, and a deterministic
+                    # re-run reproduces it. Recorded; never retried;
+                    # never raised.
+                    run.result.status = "diverged"
+                    run.result.error = error_text
+                    run.result.attempt = attempts[i]
+                    # Steps executed up to detection: the work that
+                    # produced the terminal verdict (normally stamped at
+                    # completion, which a diverged run never reaches).
+                    run.result.steps = run._step_no
+                    results[i] = run.result
+                    led.attempt_end(
+                        run.cfg.trial_id, chashes[i], attempts[i],
+                        "diverged", error=error_text,
+                        summary=_result_summary(run.result),
+                    )
+                    log0(
+                        f"Trial {run.cfg.trial_id} DIVERGED "
+                        f"({error_text}); recorded as terminal result, "
+                        "submesh freed",
+                        trial=g,
+                    )
+                    start_next(g)
+                    continue
+                if failure_class != FATAL and schedule_retry(
+                    g, i, run.cfg, error_text,
+                    progress=attempt_progress(run),
+                ):
+                    start_next(g)
+                    continue
+                run.result.status = "failed"
+                run.result.error = error_text
+                run.result.attempt = attempts[i]
+                # Work executed up to the failure (the completion path
+                # never stamped it) — consumers of the returned results
+                # see real counts, not zero, same as the diverged branch.
+                run.result.steps = run._step_no
+                results[i] = run.result
+                led.attempt_end(
+                    run.cfg.trial_id, chashes[i], attempts[i], "failed",
+                    error=error_text, summary=attempt_progress(run),
+                )
                 if not resilient:
                     raise
                 log0(

@@ -1,0 +1,167 @@
+"""Crash-safe sweep ledger: append-only JSONL attempt history.
+
+The driver's in-memory results die with the process; per-trial
+checkpoints recover *weights* but not the sweep's control state (which
+trials finished, which attempt a trial is on, what already diverged).
+The ledger is that control state, durable: one JSON object per line,
+appended and fsync'd at every attempt boundary, keyed by the trial's
+**config hash** so a restarted ``run_hpo`` trusts a "completed" record
+only when the configuration is byte-identical to what completed.
+
+Crash model: an append either lands whole or tears the final line;
+:func:`SweepLedger.load` skips undecodable lines, so a torn tail costs
+at most the last event (which the restarted sweep then simply re-runs —
+re-running a finished trial is wasteful but correct; *skipping* an
+unfinished one would not be).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Optional
+
+LEDGER_NAME = "sweep_ledger.jsonl"
+
+
+def config_hash(cfg_dict: dict) -> str:
+    """Deterministic hash of a trial's full config (sorted-key JSON).
+    Every field participates — a completed record under epochs=1 must
+    not satisfy a sweep asking for epochs=3."""
+    blob = json.dumps(cfg_dict, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+class SweepLedger:
+    """Append-only JSONL event log under ``{out_dir}/sweep_ledger.jsonl``.
+
+    ``enabled=False`` turns the whole ledger off (writes AND reads), so
+    the driver can thread one object unconditionally. Multi-controller:
+    only ``write=True`` (process 0) appends, but every process reads —
+    skip decisions must be identical everywhere, over the shared
+    filesystem the checkpoint/resume path already requires.
+    """
+
+    def __init__(
+        self, out_dir: str, *, enabled: bool = True, write: bool = True
+    ):
+        self.path = os.path.join(out_dir, LEDGER_NAME)
+        self.enabled = enabled
+        self.write = write and enabled
+
+    # -- writing -----------------------------------------------------
+
+    def append(self, event: dict) -> None:
+        if not self.write:
+            return
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        line = json.dumps({**event, "ts": time.time()}, default=str)
+        with open(self.path, "a") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def attempt_start(
+        self, trial_id: int, chash: str, attempt: int
+    ) -> None:
+        self.append(
+            {
+                "event": "attempt_start",
+                "trial_id": trial_id,
+                "config_hash": chash,
+                "attempt": attempt,
+            }
+        )
+
+    def attempt_end(
+        self,
+        trial_id: int,
+        chash: str,
+        attempt: int,
+        status: str,
+        *,
+        error: str = "",
+        summary: Optional[dict] = None,
+    ) -> None:
+        """``status``: completed | diverged | retrying | failed |
+        preempted. ``summary`` (completed/diverged) carries enough to
+        reconstruct the TrialResult on a ledger skip."""
+        self.append(
+            {
+                "event": "attempt_end",
+                "trial_id": trial_id,
+                "config_hash": chash,
+                "attempt": attempt,
+                "status": status,
+                "error": error,
+                "summary": summary or {},
+            }
+        )
+
+    # -- reading -----------------------------------------------------
+
+    def load(self) -> list[dict]:
+        """All decodable events, in append order. A torn final line
+        (crash mid-append) is skipped, not fatal."""
+        if not self.enabled or not os.path.exists(self.path):
+            return []
+        events = []
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail from a crash mid-append
+        return events
+
+    def finished(self) -> dict[str, dict]:
+        """config_hash -> final attempt_end record, for every config
+        whose outcome is settled (completed or diverged — the statuses a
+        restarted sweep must NOT re-run). A later attempt_start for the
+        same hash (a forced re-run) invalidates the earlier settlement."""
+        done: dict[str, dict] = {}
+        for ev in self.load():
+            h = ev.get("config_hash")
+            if not h:
+                continue
+            if ev.get("event") == "attempt_end" and ev.get("status") in (
+                "completed",
+                "diverged",
+            ):
+                done[h] = ev
+            elif ev.get("event") == "attempt_start" and h in done:
+                if ev.get("attempt", 0) > done[h].get("attempt", 0):
+                    done.pop(h, None)
+        return done
+
+    def attempts(self) -> dict[str, int]:
+        """config_hash -> number of attempt_start events seen (so a
+        restarted driver continues the attempt numbering, keeping the
+        ledger's history monotonic)."""
+        counts: dict[str, int] = {}
+        for ev in self.load():
+            if ev.get("event") == "attempt_start" and ev.get("config_hash"):
+                h = ev["config_hash"]
+                counts[h] = max(counts.get(h, 0), int(ev.get("attempt", 0)))
+        return counts
+
+    def infra_failures(self) -> dict[str, int]:
+        """config_hash -> infra failures recorded so far ("retrying" /
+        "failed" attempt_ends). The restarted driver seeds its retry
+        budgets from this — preempted attempts deliberately do NOT
+        count (RetryPolicy.should_retry's contract)."""
+        counts: dict[str, int] = {}
+        for ev in self.load():
+            if (
+                ev.get("event") == "attempt_end"
+                and ev.get("config_hash")
+                and ev.get("status") in ("retrying", "failed")
+            ):
+                h = ev["config_hash"]
+                counts[h] = counts.get(h, 0) + 1
+        return counts

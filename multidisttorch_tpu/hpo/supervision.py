@@ -1,0 +1,125 @@
+"""Trial supervision policy: failure classification and retry budgets.
+
+The sweep's unit of failure is ONE trial attempt. What happens next is
+a pure function of the failure's *class*, not its text:
+
+- **divergence** (:class:`~multidisttorch_tpu.train.guards.
+  DivergenceError`): the configuration itself produced a non-finite
+  loss. Deterministic training replays the same NaN on every retry, so
+  this is a terminal trial *result* (``status="diverged"``) — the sweep
+  records it and moves on.
+- **preemption / lost peer** (:class:`~multidisttorch_tpu.faults.
+  inject.HostPreemption`, or a ``TimeoutError`` from a deadline-bounded
+  cross-process agreement): the host is going away, or a peer already
+  did. Per-trial retry is meaningless — and for an expired agreement
+  actively harmful: the abandoned collective leaves this process's
+  distributed state unusable (``cluster.call_with_timeout``'s
+  contract), so retrying on the same submesh would hang again and can
+  desync later collectives. The driver re-raises so the process can
+  die; the sweep ledger makes the restarted driver resume where it
+  stopped.
+- **infra** (everything else): the environment failed around a healthy
+  trial — worker exception, data-loader fault, checkpoint I/O. Retry
+  with capped exponential backoff, resuming from the trial's last
+  *valid* checkpoint (``train.checkpoint.restore_latest_valid``), until
+  the :class:`RetryPolicy` budget is spent.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from multidisttorch_tpu.train.guards import DivergenceError
+
+INFRA = "infra"
+DIVERGENCE = "divergence"
+PREEMPTION = "preemption"
+FATAL = "fatal"
+
+
+class UnretryableError(ValueError):
+    """A deliberate hard stop that retrying would only paper over.
+
+    The strict-resume integrity guards raise this (as a ValueError
+    subclass, preserving their long-standing catchable type): a
+    config-mismatched or state/sidecar-skewed checkpoint needs a HUMAN
+    decision — a supervised retry would scan-resume past the rejected
+    checkpoint, retrain from scratch, and os.replace() the very weights
+    the guard refused to clobber. Classified FATAL: never retried,
+    never consumes budget; surfaces through the normal failure path
+    (raise, or ``status="failed"`` under ``resilient=True``).
+    """
+
+
+def classify_failure(exc: BaseException) -> str:
+    """Map an attempt's exception to its supervision class."""
+    from multidisttorch_tpu.faults.inject import HostPreemption
+
+    if isinstance(exc, DivergenceError):
+        return DIVERGENCE
+    if isinstance(exc, UnretryableError):
+        return FATAL
+    # AgreementTimeout (and ONLY that TimeoutError subtype — on 3.10+
+    # socket.timeout IS TimeoutError, and a transient I/O timeout in a
+    # trial must stay retryable) is a lost peer: the expired deadline
+    # abandoned a blocked collective on a watchdog thread, so this
+    # process's distributed state can no longer be trusted — same
+    # response as preemption (die, restart against the ledger), NOT an
+    # infra retry on the same wounded submesh.
+    from multidisttorch_tpu.parallel.cluster import AgreementTimeout
+
+    if isinstance(exc, (HostPreemption, AgreementTimeout)):
+        return PREEMPTION
+    return INFRA
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Retry budget for infra-class failures.
+
+    ``max_retries`` is the number of *re*-attempts (0 disables retry;
+    a trial runs at most ``max_retries + 1`` times). Backoff before
+    retry k (1-based) is ``min(backoff_base_s * backoff_factor**(k-1),
+    backoff_max_s)`` — capped exponential. The default base of 0.05 s
+    keeps CI fast while still exercising the deadline machinery; a
+    production sweep facing real preempt/restart storms raises it.
+    """
+
+    max_retries: int = 2
+    backoff_base_s: float = 0.05
+    backoff_factor: float = 2.0
+    backoff_max_s: float = 30.0
+
+    def __post_init__(self):
+        if self.max_retries < 0:
+            raise ValueError(
+                f"max_retries must be >= 0, got {self.max_retries}"
+            )
+        if self.backoff_base_s < 0 or self.backoff_max_s < 0:
+            raise ValueError("backoff times must be >= 0")
+        if self.backoff_factor < 1.0:
+            raise ValueError(
+                f"backoff_factor must be >= 1, got {self.backoff_factor}"
+            )
+
+    def backoff_s(self, retry_number: int) -> float:
+        """Backoff before the ``retry_number``-th retry (1-based)."""
+        if retry_number < 1:
+            raise ValueError(f"retry_number is 1-based, got {retry_number}")
+        return min(
+            self.backoff_base_s * self.backoff_factor ** (retry_number - 1),
+            self.backoff_max_s,
+        )
+
+    def should_retry(self, infra_failures: int, failure_class: str) -> bool:
+        """Whether to schedule another attempt after the trial's
+        ``infra_failures``-th infra-class failure.
+
+        The budget counts infra FAILURES, not attempts started:
+        preemptions (and restart-resumed attempts) must never consume
+        the retry budget — a trial preempted twice still deserves its
+        full ``max_retries`` against genuine infra faults.
+        """
+        if failure_class != INFRA:
+            return False
+        return infra_failures <= self.max_retries

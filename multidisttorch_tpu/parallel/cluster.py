@@ -289,7 +289,69 @@ def initialize_runtime(
     return penv.num_processes, penv.process_id
 
 
-def sync_hosts(name: str = "sync") -> None:
+class AgreementTimeout(TimeoutError):
+    """A deadline-bounded cross-process coordination call expired.
+
+    A dedicated subclass, NOT a bare ``TimeoutError``: on Python >= 3.10
+    ``socket.timeout`` IS ``TimeoutError``, so supervision matching the
+    builtin would misclassify any transient network/NFS timeout inside a
+    trial as a lost peer and kill the whole sweep. Only THIS type means
+    "the distributed state can no longer be trusted; restart against
+    the ledger" (``hpo/supervision.py`` classifies it like preemption).
+    """
+
+
+def call_with_timeout(fn, timeout_s: Optional[float], what: str):
+    """Run ``fn()`` with a wall-clock deadline; raise a *diagnosable*
+    :class:`AgreementTimeout` naming ``what`` instead of hanging
+    forever.
+
+    The failure mode this exists for: a dead/hung peer process leaves a
+    cross-process collective (barrier, health reduction) blocked with no
+    error — the reference's exact steady-state on a lost rank
+    (SURVEY.md §5). A blocked C-level collective cannot be interrupted
+    from Python, so the deadline runs ``fn`` on a watchdog thread and
+    abandons it on expiry: the stuck thread leaks (daemon — it dies with
+    the process), which is the honest trade for turning an indefinite
+    hang into an actionable error. ``timeout_s=None`` or <= 0 means no
+    deadline (direct call).
+    """
+    if timeout_s is None or timeout_s <= 0:
+        return fn()
+    import threading
+
+    box: dict = {}
+
+    def runner():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            box["error"] = e
+
+    t = threading.Thread(target=runner, daemon=True, name=f"watchdog:{what}")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise AgreementTimeout(
+            f"{what} did not complete within {timeout_s:g}s — a "
+            "participating process is likely dead, preempted, or hung. "
+            "The blocked collective was abandoned on a daemon thread; "
+            "treat this process's distributed state as unusable and "
+            "restart the job (the sweep ledger makes the restart cheap)."
+        )
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+def _env_timeout(env_var: str, default: Optional[float]) -> Optional[float]:
+    raw = os.environ.get(env_var)
+    if raw is None or raw == "":
+        return default
+    return float(raw)
+
+
+def sync_hosts(name: str = "sync", *, timeout_s: Optional[float] = None) -> None:
     """Barrier across host processes (multi-controller only).
 
     The analog of the reference's ``dist.barrier()`` — but deliberately
@@ -298,13 +360,29 @@ def sync_hosts(name: str = "sync") -> None:
     coordination such as "download data once before dispatch"
     (``vae-hpo.py:133-144``) and end-of-job collection. No-op
     single-controller.
+
+    ``timeout_s`` (default: ``MDT_SYNC_TIMEOUT_S`` env var, else 1800)
+    bounds the wait: a dead peer turns into a descriptive
+    :class:`AgreementTimeout` naming the barrier instead of an
+    indefinite hang — the reference's unbounded ``dist.barrier()`` is
+    exactly the failure this guards against. The default is deliberately
+    generous (30 min): this barrier's documented use is "wait while one
+    host downloads the dataset", which is legitimately slow; jobs whose
+    barriers wait even longer pass ``timeout_s`` explicitly or ``0`` /
+    ``MDT_SYNC_TIMEOUT_S=0`` for the old unbounded behavior.
     """
     import jax
 
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(name)
+        if timeout_s is None:
+            timeout_s = _env_timeout("MDT_SYNC_TIMEOUT_S", 1800.0)
+        call_with_timeout(
+            lambda: multihost_utils.sync_global_devices(name),
+            timeout_s,
+            f"host barrier {name!r} over {jax.process_count()} processes",
+        )
 
 
 def process_world() -> tuple[int, int]:

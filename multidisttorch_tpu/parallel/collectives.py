@@ -117,7 +117,13 @@ def _sum_flags_fn(mesh: Mesh):
     )
 
 
-def group_all_ok(trial: TrialMesh, ok: bool) -> bool:
+def group_all_ok(
+    trial: TrialMesh,
+    ok: bool,
+    *,
+    timeout_s: float | None = None,
+    what: str = "group health agreement",
+) -> bool:
     """Cross-process health agreement scoped to ONE trial submesh.
 
     Returns True iff every process owning a device of this group called
@@ -133,21 +139,34 @@ def group_all_ok(trial: TrialMesh, ok: bool) -> bool:
     point in its dispatch sequence for this group (the HPO driver calls
     it at trial setup and at each epoch boundary — deterministic
     cadence).
+
+    ``timeout_s`` bounds the wait on the reduction's result fetch: an
+    owner process that died before contributing leaves the collective
+    blocked forever — with a deadline it becomes a ``TimeoutError``
+    naming ``what`` (``parallel.cluster.call_with_timeout`` semantics:
+    the stuck collective is abandoned on a daemon thread; the caller
+    should treat the group as lost and restart against the sweep
+    ledger). ``None``/0 = unbounded, the pre-timeout behavior.
     """
     import numpy as np
 
-    n = trial.size
-    # One element per member device, each process filling its
-    # addressable shards with its own health bit.
-    sharding = trial.sharding(tuple(trial.mesh.axis_names))
-    local = np.zeros(1, np.float32) if ok else np.ones(1, np.float32)
-    if jax.process_count() == 1:
-        flags = jax.device_put(
-            np.full(n, local[0], np.float32), sharding
-        )
-    else:
-        flags = jax.make_array_from_callback(
-            (n,), sharding, lambda idx: local
-        )
-    failed = _sum_flags_fn(trial.mesh)(flags)
-    return float(failed) == 0.0
+    from multidisttorch_tpu.parallel.cluster import call_with_timeout
+
+    def agree() -> bool:
+        n = trial.size
+        # One element per member device, each process filling its
+        # addressable shards with its own health bit.
+        sharding = trial.sharding(tuple(trial.mesh.axis_names))
+        local = np.zeros(1, np.float32) if ok else np.ones(1, np.float32)
+        if jax.process_count() == 1:
+            flags = jax.device_put(
+                np.full(n, local[0], np.float32), sharding
+            )
+        else:
+            flags = jax.make_array_from_callback(
+                (n,), sharding, lambda idx: local
+            )
+        failed = _sum_flags_fn(trial.mesh)(flags)
+        return float(failed) == 0.0
+
+    return call_with_timeout(agree, timeout_s, what)

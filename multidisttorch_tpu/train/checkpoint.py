@@ -1,4 +1,4 @@
-"""Trial-state checkpoint/resume.
+"""Trial-state checkpoint/resume with crash-safe durability.
 
 The reference persists nothing but PNGs (SURVEY.md §5 — no
 ``torch.save`` anywhere); checkpointing is an explicit upgrade required
@@ -7,43 +7,258 @@ between submeshes. State is a plain pytree (``train.steps.TrainState``),
 serialized with flax's msgpack codec; restore re-places it onto any
 target submesh — the same mechanism serves disk checkpoints and
 inter-trial weight broadcast.
+
+Durability contract (the fault-tolerance subsystem's foundation,
+docs/RESILIENCE.md):
+
+- **Atomic + durable writes**: tmp file, ``fsync``, ``os.replace``,
+  directory ``fsync`` — a crash (or power loss) mid-write can never
+  tear the visible ``state.msgpack``; either the old file or the new
+  one is fully there.
+- **CRC32-verified sidecars**: the metadata sidecar records the state
+  file's CRC32 + byte count (``_integrity``), so a reader can tell a
+  valid checkpoint from a corrupt/rotted one — and tell "state newer
+  than sidecar" (a crash landed between the two replaces) from a
+  healthy pair.
+- **Keep-last-K retention** (``keep_last``): each save also retains an
+  independent versioned copy ``{path}.v{step}`` (a real copy, not a
+  hard-link — see :func:`_copy_replace`) and prunes beyond K, so a torn
+  or corrupt latest still has valid history behind it.
+- **:func:`restore_latest_valid`**: scan newest→oldest past torn/
+  corrupt candidates and restore the first verifiable one — what
+  retry-with-resume (``hpo/driver.py``) uses, where ``restore_state``'s
+  strict single-file semantics would abandon recoverable work.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Optional
+import re
+import shutil
+import zlib
+from typing import Any, Callable, Optional
 
 import jax
 from flax import serialization
 
 from multidisttorch_tpu.parallel.mesh import TrialMesh
 
+_VERSION_RE = re.compile(r"\.v(\d+)$")
 
-def save_state(state: Any, path: str, *, metadata: Optional[dict] = None) -> str:
+
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be read/verified (and no fallback said
+    otherwise)."""
+
+
+def _fsync_dir(path: str) -> None:
+    """Durably record a directory entry (the rename itself) — without
+    this, a power loss after ``os.replace`` can resurrect the old file.
+    Best-effort: some filesystems refuse O_RDONLY dir fsync."""
+    d = os.path.dirname(path) or "."
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _write_atomic(path: str, blob: bytes, *, fsync: bool) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+    if fsync:
+        _fsync_dir(path)
+
+
+def _copy_replace(src: str, dst: str) -> None:
+    """Atomically make ``dst`` an independent COPY of ``src``. A
+    hard-link would be free, but it shares the inode: in-place
+    corruption (bit rot, a torn rewrite) of the primary would garble
+    its newest retained version with it, silently shrinking the
+    scan-back depth from K to K-1. States here are small; pay the copy
+    and keep the retention contract exact."""
+    tmp = dst + ".tmp"
+    if os.path.exists(tmp):
+        os.remove(tmp)
+    shutil.copy2(src, tmp)
+    os.replace(tmp, dst)
+
+
+def save_state(
+    state: Any,
+    path: str,
+    *,
+    metadata: Optional[dict] = None,
+    keep_last: int = 1,
+    fsync: bool = True,
+) -> str:
     """Serialize a state pytree (host-side) to ``path`` (msgpack).
 
-    Writes are atomic (tmp file + ``os.replace``): a crash mid-write —
-    including the interpreter exiting while a background checkpoint
-    thread is running — can never leave a torn ``state.msgpack`` that
-    breaks a later ``resume``. The state file lands before the metadata
-    sidecar, so a reader never sees metadata describing a state that
-    isn't there yet.
+    Writes are atomic AND durable (tmp file + ``fsync`` +
+    ``os.replace`` + directory ``fsync``): a crash mid-write — including
+    the interpreter exiting while a background checkpoint thread is
+    running, or the host losing power — can never leave a torn
+    ``state.msgpack`` that breaks a later ``resume``. The state file
+    lands before the metadata sidecar, so a reader never sees metadata
+    describing a state that isn't there yet; the sidecar carries the
+    state's CRC32 (``_integrity``) so a reader can detect the converse
+    tear (state replaced, crash before the sidecar followed).
+
+    ``keep_last=K`` (K > 1) additionally retains the K most recent
+    checkpoints as independent ``{path}.v{step}`` copies (version id =
+    ``metadata['step']`` when present, else a monotonic counter), giving
+    :func:`restore_latest_valid` history to scan back through when the
+    latest is torn or corrupted. ``fsync=False`` opts out of the
+    durability syncs (benchmarks on throwaway dirs).
     """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     _require_fully_addressable(state, "save_state")
     host_state = jax.device_get(state)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(serialization.to_bytes(host_state))
-    os.replace(tmp, path)
-    if metadata is not None:
-        meta_tmp = path + ".json.tmp"
-        with open(meta_tmp, "w") as f:
-            json.dump(metadata, f, indent=2, default=str)
-        os.replace(meta_tmp, path + ".json")
+    blob = serialization.to_bytes(host_state)
+    _write_atomic(path, blob, fsync=fsync)
+
+    meta = dict(metadata) if metadata is not None else {}
+    meta["_integrity"] = {"crc32": zlib.crc32(blob), "nbytes": len(blob)}
+    _write_atomic(
+        path + ".json",
+        json.dumps(meta, indent=2, default=str).encode(),
+        fsync=fsync,
+    )
+
+    if keep_last > 1:
+        _retain_version(path, meta, keep_last)
     return path
+
+
+def _versions(path: str) -> list[tuple[int, str]]:
+    """Existing ``{path}.v{N}`` siblings, newest first."""
+    d = os.path.dirname(path) or "."
+    base = os.path.basename(path)
+    out = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return []
+    for name in names:
+        if not name.startswith(base + ".v") or name.endswith(
+            (".json", ".tmp")
+        ):
+            continue
+        m = _VERSION_RE.search(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(d, name)))
+    out.sort(reverse=True)
+    return out
+
+
+def _retain_version(path: str, meta: dict, keep_last: int) -> None:
+    step = meta.get("step")
+    if step is None:
+        existing = _versions(path)
+        step = (existing[0][0] + 1) if existing else 1
+    ver = f"{path}.v{int(step):010d}"
+    _copy_replace(path, ver)
+    _copy_replace(path + ".json", ver + ".json")
+    for _, old in _versions(path)[keep_last:]:
+        for p in (old, old + ".json"):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+
+
+def checkpoint_candidates(path: str) -> list[str]:
+    """Restore candidates, newest first: the primary path, then retained
+    versions in descending version order."""
+    return [path] + [p for _, p in _versions(path)]
+
+
+def verify_checkpoint(path: str) -> tuple[bool, Optional[dict], str]:
+    """``(ok, metadata, reason)`` for one candidate file.
+
+    A candidate is valid when its sidecar parses and the state bytes
+    match the sidecar's CRC32/length. Legacy checkpoints (no
+    ``_integrity`` — written before this layer existed) fall back to a
+    structural msgpack decode; a missing sidecar is accepted the same
+    way (``restore_state`` never required one).
+    """
+    if not os.path.exists(path):
+        return False, None, "missing"
+    meta: Optional[dict] = None
+    meta_path = path + ".json"
+    if os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                meta = json.load(f)
+        except (OSError, ValueError) as e:
+            return False, None, f"sidecar unreadable: {e}"
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        return False, meta, f"state unreadable: {e}"
+    integ = (meta or {}).get("_integrity")
+    if integ is not None:
+        if len(blob) != int(integ.get("nbytes", -1)):
+            return False, meta, (
+                f"size mismatch ({len(blob)} vs recorded "
+                f"{integ.get('nbytes')}) — torn write"
+            )
+        if zlib.crc32(blob) != int(integ.get("crc32", -1)):
+            return False, meta, "crc32 mismatch — corrupt or torn state"
+        return True, meta, "ok"
+    try:  # legacy (pre-CRC) checkpoint: structural check only
+        serialization.msgpack_restore(blob)
+    except Exception as e:  # noqa: BLE001 — any decode failure disqualifies
+        return False, meta, f"msgpack undecodable: {e}"
+    return True, meta, "ok"
+
+
+def restore_latest_valid(
+    template: Any,
+    path: str,
+    trial: Optional[TrialMesh] = None,
+    *,
+    shardings: Any = None,
+    accept_meta: Optional[Callable[[dict], bool]] = None,
+) -> Optional[tuple[Any, dict, str]]:
+    """Restore the newest checkpoint that verifies, scanning back past
+    torn/corrupt candidates (the latest file, then ``keep_last``
+    history).
+
+    ``accept_meta`` optionally gates candidates on their sidecar (e.g.
+    "config must match the retrying trial's"); rejected candidates are
+    skipped like corrupt ones, not fatal. Returns ``(state, metadata,
+    used_path)`` — or ``None`` when nothing valid remains, which a
+    supervisor treats as "retry from scratch", never an error: recovery
+    must degrade, not wedge.
+    """
+    for cand in checkpoint_candidates(path):
+        ok, meta, _reason = verify_checkpoint(cand)
+        if not ok:
+            continue
+        meta = meta or {}
+        if accept_meta is not None and not accept_meta(meta):
+            continue
+        try:
+            restored = restore_state(
+                template, cand, trial, shardings=shardings
+            )
+        except Exception:  # noqa: BLE001 — scan on (CRC can't catch all)
+            continue
+        return restored, meta, cand
+    return None
 
 
 def _require_fully_addressable(tree: Any, op: str) -> None:
@@ -77,6 +292,10 @@ def restore_state(
     """Restore into the structure of ``template``; optionally place onto
     ``trial``'s submesh (checkpoint-restart or PBT exploit onto a
     different device group).
+
+    Strict single-file semantics: a torn/corrupt ``path`` raises. The
+    scan-back sibling for supervised recovery is
+    :func:`restore_latest_valid`.
 
     Placement defaults to replicated — correct for the plain-DP trials
     the driver runs. A weight-sharded state (TP/FSDP/EP) must pass its

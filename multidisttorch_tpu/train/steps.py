@@ -22,7 +22,7 @@ logging arithmetic (``vae-hpo.py:83,89,118``) carries over unchanged.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -733,6 +733,40 @@ def make_lane_ops(trial: TrialMesh):
         donate_argnums=(0,),
     )
     return read_j, write_j
+
+
+def wrap_step_with_hooks(
+    step_fn: Callable,
+    *,
+    before: Optional[Callable] = None,
+    transform_batch: Optional[Callable] = None,
+    batch_argnum: int = 1,
+) -> Callable:
+    """Host-side hook seam around a compiled step — the fault-injection
+    thread-through point (``faults/inject.py`` via ``hpo/driver.py``),
+    usable for any pre-dispatch instrumentation.
+
+    ``before(batch)`` runs before the dispatch (it may raise — an
+    injected crash/preemption — or stall — an injected straggler);
+    ``transform_batch(batch) -> batch`` may replace the batch operand
+    (NaN poisoning for divergence drills). Both see the positional
+    argument at ``batch_argnum``. The compiled program itself is
+    untouched: hooks never change shapes, so nothing recompiles, and a
+    ``None``-hook wrap is exactly the bare step.
+    """
+    if before is None and transform_batch is None:
+        return step_fn
+
+    def hooked(*args, **kwargs):
+        args = list(args)
+        batch = args[batch_argnum]
+        if before is not None:
+            before(batch)
+        if transform_batch is not None:
+            args[batch_argnum] = transform_batch(batch)
+        return step_fn(*args, **kwargs)
+
+    return hooked
 
 
 def make_eval_step(

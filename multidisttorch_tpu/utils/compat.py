@@ -55,6 +55,40 @@ def shard_map(
     )
 
 
+def ensure_partitionable_rng() -> bool:
+    """Pin ``jax_threefry_partitionable=True`` (the modern default) on
+    toolchains that still default it off. Returns the resulting setting.
+
+    Under the legacy (non-partitionable) threefry lowering, the VALUES
+    of ``jax.random`` draws inside a sharded jit program depend on the
+    mesh topology — the same key, same shape reparameterization noise
+    comes out different on a (2 data × 4 model) submesh than on the
+    8-wide DP submesh. That is not reduction-order noise: a TP trial
+    literally trains on different sample noise than its DP twin, which
+    is how the tier-1 TP-vs-DP parity tests (`test_tp_training_matches_
+    data_parallel`, `test_run_hpo_with_model_parallel_tp_shardings`)
+    drifted 0.3–1.7% on the pinned jaxlib (default False there).
+    Partitionable threefry makes draws a pure function of (key, shape)
+    regardless of sharding — measured TP-vs-DP agreement goes from
+    ~1e-2 to ~1e-7 relative. Called at package import — but an
+    EXPLICIT user choice wins: when ``JAX_THREEFRY_PARTITIONABLE`` is
+    set in the environment (e.g. ``0`` to bit-reproduce a legacy run),
+    this never overrides it; jax's own config/context managers also
+    remain available per-program.
+    """
+    import os
+
+    if os.environ.get("JAX_THREEFRY_PARTITIONABLE", "") != "":
+        return bool(
+            getattr(jax.config, "jax_threefry_partitionable", True)
+        )
+    try:
+        jax.config.update("jax_threefry_partitionable", True)
+    except AttributeError:  # flag retired upstream: partitionable-only
+        return True
+    return bool(jax.config.jax_threefry_partitionable)
+
+
 def pallas_tpu_compiler_params(**kwargs):
     """TPU pallas compiler params across the name drift: modern
     ``pltpu.CompilerParams`` vs the pinned toolchain's

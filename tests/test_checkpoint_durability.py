@@ -1,0 +1,203 @@
+"""Durable-checkpoint contract (train/checkpoint.py): CRC sidecars,
+fsync'd atomic writes, keep-last-K retention, and restore_latest_valid
+scanning back past torn/corrupt files."""
+
+import json
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+from flax import serialization
+
+from multidisttorch_tpu.faults.inject import corrupt_file
+from multidisttorch_tpu.models.vae import VAE
+from multidisttorch_tpu.parallel.mesh import setup_groups
+from multidisttorch_tpu.train.checkpoint import (
+    checkpoint_candidates,
+    restore_latest_valid,
+    save_state,
+    verify_checkpoint,
+)
+from multidisttorch_tpu.train.steps import build_train_state
+
+
+def _state(step=0, seed=0):
+    s = build_train_state(
+        VAE(hidden_dim=16, latent_dim=4), optax.adam(1e-3), jax.random.key(seed)
+    )
+    import jax.numpy as jnp
+
+    return s.replace(step=jnp.asarray(step, jnp.int32))
+
+
+def _params_equal(a, b):
+    leaves_a, leaves_b = jax.tree.leaves(a), jax.tree.leaves(b)
+    return all(np.array_equal(x, y) for x, y in zip(leaves_a, leaves_b))
+
+
+def test_crc_sidecar_written_and_verified(tmp_path):
+    path = str(tmp_path / "state.msgpack")
+    save_state(_state(3), path, metadata={"step": 3})
+    ok, meta, reason = verify_checkpoint(path)
+    assert ok, reason
+    assert meta["_integrity"]["crc32"] == __import__("zlib").crc32(
+        open(path, "rb").read()
+    )
+    assert meta["_integrity"]["nbytes"] == os.path.getsize(path)
+
+    corrupt_file(path)
+    ok, _, reason = verify_checkpoint(path)
+    assert not ok and "crc32 mismatch" in reason
+
+
+def test_verify_rejects_torn_size_and_unreadable_sidecar(tmp_path):
+    path = str(tmp_path / "state.msgpack")
+    save_state(_state(1), path, metadata={"step": 1})
+    with open(path, "ab") as f:
+        f.write(b"xx")  # grew after the sidecar recorded its length
+    ok, _, reason = verify_checkpoint(path)
+    assert not ok and "size mismatch" in reason
+
+    save_state(_state(1), path, metadata={"step": 1})
+    with open(path + ".json", "w") as f:
+        f.write("{not json")
+    ok, _, reason = verify_checkpoint(path)
+    assert not ok and "sidecar unreadable" in reason
+
+
+def test_legacy_checkpoint_without_integrity_still_accepted(tmp_path):
+    # Pre-CRC sidecars (or none at all) fall back to a structural
+    # msgpack check — old checkpoints stay restorable.
+    path = str(tmp_path / "state.msgpack")
+    save_state(_state(2), path, metadata={"step": 2})
+    meta = json.load(open(path + ".json"))
+    del meta["_integrity"]
+    with open(path + ".json", "w") as f:
+        json.dump(meta, f)
+    ok, _, reason = verify_checkpoint(path)
+    assert ok, reason
+    os.remove(path + ".json")
+    ok, _, reason = verify_checkpoint(path)
+    assert ok, reason
+
+
+def test_keep_last_retention_prunes_old_versions(tmp_path):
+    path = str(tmp_path / "state.msgpack")
+    for step in (8, 16, 24, 32):
+        save_state(_state(step), path, metadata={"step": step}, keep_last=2)
+    cands = checkpoint_candidates(path)
+    # primary + the 2 newest versions; steps 8 and 16 pruned
+    assert cands[0] == path
+    assert [os.path.basename(c) for c in cands[1:]] == [
+        "state.msgpack.v0000000032",
+        "state.msgpack.v0000000024",
+    ]
+    assert not os.path.exists(path + ".v0000000008")
+    # every retained candidate verifies (sidecars versioned alongside)
+    for c in cands:
+        ok, _, reason = verify_checkpoint(c)
+        assert ok, (c, reason)
+
+
+def test_restore_latest_valid_scans_past_corruption(tmp_path):
+    (g,) = setup_groups(1)
+    path = str(tmp_path / "state.msgpack")
+    s16, s24 = _state(16, seed=1), _state(24, seed=2)
+    save_state(s16, path, metadata={"step": 16, "completed_epochs": 2},
+               keep_last=2)
+    save_state(s24, path, metadata={"step": 24, "completed_epochs": 3},
+               keep_last=2)
+    # Bit-rot the primary: its retained version is an independent COPY
+    # (not a hard link — shared inodes would garble both names at
+    # once), so recovery lands on the SAME generation's version first.
+    corrupt_file(path)
+    got = restore_latest_valid(_state(), path, g)
+    assert got is not None
+    restored, meta, used = got
+    assert int(meta["step"]) == 24
+    assert used.endswith(".v0000000024")
+    assert _params_equal(jax.device_get(restored.params), s24.params)
+    # Rot that version too: the scan falls through to the previous
+    # generation.
+    corrupt_file(path + ".v0000000024")
+    restored, meta, used = restore_latest_valid(_state(), path, g)
+    assert int(meta["step"]) == 16
+    assert used.endswith(".v0000000016")
+    assert int(jax.device_get(restored.step)) == 16
+    assert _params_equal(jax.device_get(restored.params), s16.params)
+
+
+def test_torn_write_between_state_and_sidecar_falls_back(tmp_path):
+    # Satellite regression: a crash landing between the state replace
+    # and the sidecar replace leaves new bytes under the old sidecar
+    # (whose CRC describes the previous state). restore_latest_valid
+    # must fall back cleanly to the retained previous generation — the
+    # strict resume path raises on the same artifact.
+    (g,) = setup_groups(1)
+    path = str(tmp_path / "state.msgpack")
+    s8 = _state(8, seed=1)
+    save_state(s8, path, metadata={"step": 8, "completed_epochs": 1},
+               keep_last=2)
+    # Simulate save_state dying after its first os.replace: the state
+    # file is replaced with epoch-2 bytes, the sidecar never follows.
+    torn = serialization.to_bytes(jax.device_get(_state(16, seed=9)))
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(torn)
+    os.replace(tmp, path)
+
+    ok, _, reason = verify_checkpoint(path)
+    assert not ok and "crc32 mismatch" in reason
+    got = restore_latest_valid(_state(), path, g)
+    assert got is not None
+    restored, meta, used = got
+    assert int(meta["step"]) == 8
+    assert _params_equal(jax.device_get(restored.params), s8.params)
+
+
+def test_restore_latest_valid_none_when_nothing_survives(tmp_path):
+    path = str(tmp_path / "state.msgpack")
+    save_state(_state(8), path, metadata={"step": 8})  # keep_last=1
+    corrupt_file(path)
+    (g,) = setup_groups(1)
+    assert restore_latest_valid(_state(), path, g) is None
+    assert restore_latest_valid(_state(), str(tmp_path / "absent"), g) is None
+
+
+def test_restore_latest_valid_honors_accept_meta(tmp_path):
+    (g,) = setup_groups(1)
+    path = str(tmp_path / "state.msgpack")
+    save_state(_state(8), path, metadata={"step": 8, "lr": 1e-3},
+               keep_last=2)
+    save_state(_state(16), path, metadata={"step": 16, "lr": 5e-2},
+               keep_last=2)
+    got = restore_latest_valid(
+        _state(), path, g, accept_meta=lambda m: m.get("lr") == 1e-3
+    )
+    assert got is not None and int(got[1]["step"]) == 8
+
+
+def test_save_state_fsyncs_before_replace(tmp_path, monkeypatch):
+    # The durability half of the atomicity claim: data must hit the
+    # disk BEFORE the rename makes it visible, or power loss can
+    # resurrect a torn file through the new name.
+    events = []
+    real_fsync, real_replace = os.fsync, os.replace
+    monkeypatch.setattr(os, "fsync", lambda fd: events.append("fsync"))
+    monkeypatch.setattr(
+        os, "replace",
+        lambda a, b: (events.append("replace"), real_replace(a, b))[1],
+    )
+    save_state(_state(1), str(tmp_path / "s.msgpack"), metadata={"step": 1})
+    # state write: fsync precedes its replace; sidecar likewise
+    assert events.index("fsync") < events.index("replace")
+    assert events.count("fsync") >= 2  # file syncs for state + sidecar
+
+    events.clear()
+    save_state(
+        _state(2), str(tmp_path / "s.msgpack"), metadata={"step": 2},
+        fsync=False,
+    )
+    assert "fsync" not in events  # the documented opt-out

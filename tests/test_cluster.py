@@ -159,3 +159,101 @@ class TestSelectPlatform:
     def test_default_argument(self):
         assert select_platform({}, default="cpu") == "cpu"
         assert select_platform({"MDT_PLATFORM": ""}, default="cpu") == "cpu"
+
+
+class TestTimeouts:
+    """Deadline-bounded cross-process coordination (satellite of the
+    chaos-supervision PR): a dead peer must produce a diagnosable
+    error, not an indefinite hang — the reference's lost-rank failure
+    mode (SURVEY.md §5)."""
+
+    def test_call_with_timeout_passes_value_and_errors_through(self):
+        from multidisttorch_tpu.parallel.cluster import call_with_timeout
+
+        assert call_with_timeout(lambda: 42, 5.0, "probe") == 42
+        assert call_with_timeout(lambda: 42, None, "no deadline") == 42
+        with pytest.raises(KeyError, match="boom"):
+            call_with_timeout(
+                lambda: (_ for _ in ()).throw(KeyError("boom")),
+                5.0,
+                "probe",
+            )
+
+    def test_call_with_timeout_raises_descriptive_timeout(self):
+        import time as _time
+
+        from multidisttorch_tpu.parallel.cluster import call_with_timeout
+
+        with pytest.raises(TimeoutError, match="epoch-3 agreement"):
+            call_with_timeout(
+                lambda: _time.sleep(10), 0.1, "epoch-3 agreement"
+            )
+
+    def test_sync_hosts_times_out_on_slow_participant(self, monkeypatch):
+        # Mocked slow participant: a 2-process world whose barrier
+        # never returns. The timeout must name the barrier.
+        import time as _time
+
+        import jax
+        from jax.experimental import multihost_utils
+
+        from multidisttorch_tpu.parallel.cluster import sync_hosts
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            multihost_utils,
+            "sync_global_devices",
+            lambda name: _time.sleep(10),
+        )
+        with pytest.raises(TimeoutError, match="post-data-download"):
+            sync_hosts("post-data-download", timeout_s=0.1)
+
+    def test_sync_hosts_timeout_env_default(self, monkeypatch):
+        import time as _time
+
+        import jax
+        from jax.experimental import multihost_utils
+
+        from multidisttorch_tpu.parallel.cluster import sync_hosts
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            multihost_utils,
+            "sync_global_devices",
+            lambda name: _time.sleep(10),
+        )
+        monkeypatch.setenv("MDT_SYNC_TIMEOUT_S", "0.1")
+        with pytest.raises(TimeoutError):
+            sync_hosts("env-default")
+
+    def test_group_all_ok_times_out_with_diagnosable_error(self, monkeypatch):
+        # The driver's _agree_boundary primitive under a hung peer: the
+        # reduction never resolves, the deadline turns it into an error
+        # naming the agreement point.
+        import time as _time
+
+        from multidisttorch_tpu.parallel import collectives
+        from multidisttorch_tpu.parallel.mesh import setup_groups
+
+        (g,) = setup_groups(1)
+        monkeypatch.setattr(
+            collectives,
+            "_sum_flags_fn",
+            lambda mesh: lambda flags: _time.sleep(10),
+        )
+        with pytest.raises(
+            TimeoutError, match="trial 7 epoch 2 boundary"
+        ):
+            collectives.group_all_ok(
+                g, True, timeout_s=0.1,
+                what="trial 7 epoch 2 boundary health agreement",
+            )
+
+    def test_group_all_ok_unbounded_still_works(self):
+        from multidisttorch_tpu.parallel.collectives import group_all_ok
+        from multidisttorch_tpu.parallel.mesh import setup_groups
+
+        (g,) = setup_groups(1)
+        assert group_all_ok(g, True) is True
+        assert group_all_ok(g, False) is False
+        assert group_all_ok(g, True, timeout_s=30.0) is True

@@ -1,0 +1,462 @@
+"""Chaos drills: deterministic fault injection against run_hpo's trial
+supervision — retry-with-resume, divergence classification, stacked
+lane recovery, and the crash-safe sweep ledger. Every path here is the
+CI face of the acceptance contract in docs/RESILIENCE.md."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from multidisttorch_tpu.data.datasets import synthetic_mnist
+from multidisttorch_tpu.faults import (
+    CKPT_CORRUPT,
+    CRASH,
+    DATA_ERROR,
+    DIVERGE,
+    PREEMPT,
+    SLOW,
+    FaultPlan,
+    FaultSpec,
+    HostPreemption,
+)
+from multidisttorch_tpu.hpo.driver import TrialConfig, run_hpo
+from multidisttorch_tpu.hpo.ledger import LEDGER_NAME, SweepLedger
+from multidisttorch_tpu.hpo.supervision import RetryPolicy
+
+pytestmark = pytest.mark.chaos
+
+# 128 rows / batch 16 = 8 optimizer steps per epoch, everywhere below.
+STEPS_PER_EPOCH = 8
+
+
+def _cfg(trial_id, **kw):
+    defaults = dict(
+        trial_id=trial_id,
+        epochs=3,
+        batch_size=16,
+        hidden_dim=32,
+        latent_dim=8,
+        log_interval=10_000,
+        seed=trial_id,
+    )
+    defaults.update(kw)
+    return TrialConfig(**defaults)
+
+
+@pytest.fixture(scope="module")
+def data():
+    return synthetic_mnist(128, seed=0)
+
+
+def _sweep(configs, data, out_dir, **kw):
+    base = dict(
+        num_groups=1,
+        out_dir=str(out_dir),
+        verbose=False,
+        save_images=False,
+        resilient=True,
+        retry=RetryPolicy(max_retries=2, backoff_base_s=0.01),
+    )
+    base.update(kw)
+    return run_hpo(configs, data, None, **base)
+
+
+def _events(out_dir, trial_id=None, status=None):
+    evs = SweepLedger(str(out_dir)).load()
+    if trial_id is not None:
+        evs = [e for e in evs if e.get("trial_id") == trial_id]
+    if status is not None:
+        evs = [e for e in evs if e.get("status") == status]
+    return evs
+
+
+def test_fault_plan_roundtrip_and_validation():
+    plan = FaultPlan.standard([0, 1, 2, 3, 4, 5], seed=7)
+    again = FaultPlan.from_json(plan.to_json())
+    assert again == plan
+    assert {s.kind for s in plan.specs} == {
+        CRASH, DATA_ERROR, CKPT_CORRUPT, SLOW, DIVERGE, PREEMPT
+    }
+    # the parity control: the last trial carries no faults
+    assert not plan.for_trial(5)
+    # determinism in the seed
+    assert FaultPlan.standard([0, 1, 2], seed=7) == FaultPlan.standard(
+        [0, 1, 2], seed=7
+    )
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultSpec("meteor", 0, step=1)
+    with pytest.raises(ValueError, match="epoch"):
+        FaultSpec(CKPT_CORRUPT, 0)  # epoch-scoped kind needs epoch
+    with pytest.raises(ValueError, match="step"):
+        FaultSpec(CRASH, 0)  # step-scoped kind needs step
+
+
+def test_failure_classification_contract():
+    from multidisttorch_tpu.hpo.supervision import (
+        DIVERGENCE,
+        INFRA,
+        PREEMPTION,
+        classify_failure,
+    )
+    from multidisttorch_tpu.train.guards import DivergenceError
+
+    assert classify_failure(RuntimeError("worker died")) == INFRA
+    assert classify_failure(OSError("disk full")) == INFRA
+    assert classify_failure(DivergenceError("loss", float("nan"))) == DIVERGENCE
+    assert classify_failure(HostPreemption("gone")) == PREEMPTION
+    # An expired agreement deadline = a lost peer: the submesh can no
+    # longer be trusted, so this must NOT be an infra retry...
+    from multidisttorch_tpu.parallel.cluster import AgreementTimeout
+
+    assert classify_failure(AgreementTimeout("agreement expired")) == PREEMPTION
+    # ...but a BARE TimeoutError is a transient I/O fault (socket.timeout
+    # IS TimeoutError on 3.10+) and must stay retryable.
+    import socket
+
+    assert classify_failure(TimeoutError("nfs hiccup")) == INFRA
+    assert classify_failure(socket.timeout("slow read")) == INFRA
+
+
+def test_injected_crash_retried_resumes_bit_identical(tmp_path, data):
+    # THE tentpole contract: a mid-epoch-2 crash retries from the
+    # epoch-1 checkpoint and the final metrics are bit-identical to the
+    # fault-free run (between-checkpoint faults cost replay, not
+    # correctness).
+    clean = _sweep([_cfg(0)], data, tmp_path / "clean")[0]
+    plan = FaultPlan(specs=(FaultSpec(CRASH, 0, step=STEPS_PER_EPOCH + 3),))
+    (r,) = _sweep([_cfg(0)], data, tmp_path / "chaos", fault_plan=plan)
+    assert r.status == "completed"
+    assert r.attempt == 2
+    assert r.steps == 3 * STEPS_PER_EPOCH
+    assert r.final_train_loss == clean.final_train_loss  # bitwise
+    # ledger shows the attempt history: retrying -> completed
+    assert [e["status"] for e in _events(tmp_path / "chaos", 0)
+            if e["event"] == "attempt_end"] == ["retrying", "completed"]
+    # the retry resumed from the epoch-1 checkpoint, not step 0
+    done = _events(tmp_path / "chaos", 0, "completed")[0]
+    assert done["summary"]["resumed_from_step"] == STEPS_PER_EPOCH
+
+
+def test_data_error_recovered_and_slow_survives(tmp_path, data):
+    clean = _sweep([_cfg(0)], data, tmp_path / "clean")[0]
+    plan = FaultPlan(specs=(
+        FaultSpec(DATA_ERROR, 0, step=STEPS_PER_EPOCH + 2),
+        FaultSpec(SLOW, 0, step=2, delay_s=0.05),
+    ))
+    (r,) = _sweep([_cfg(0)], data, tmp_path / "chaos", fault_plan=plan)
+    assert r.status == "completed" and r.attempt == 2
+    assert r.final_train_loss == clean.final_train_loss
+    assert "DataFault" in _events(tmp_path / "chaos", 0, "retrying")[0]["error"]
+
+
+def test_divergence_is_terminal_not_retried(tmp_path, data):
+    # NaN-poisoned batch -> genuinely non-finite loss through the real
+    # compiled step -> classified terminal: status diverged, ONE
+    # attempt, no infra retry burned, sweep alive for the other trial.
+    plan = FaultPlan(specs=(FaultSpec(DIVERGE, 0, step=2),))
+    results = _sweep(
+        [_cfg(0), _cfg(1)], data, tmp_path, fault_plan=plan
+    )
+    by_id = {r.trial_id: r for r in results}
+    assert by_id[0].status == "diverged"
+    assert by_id[0].attempt == 1
+    assert "non-finite" in by_id[0].error
+    assert by_id[0].steps == STEPS_PER_EPOCH  # detected at epoch boundary
+    assert by_id[1].status == "completed"
+    assert np.isfinite(by_id[1].final_train_loss)
+    assert not _events(tmp_path, 0, "retrying")
+
+
+def test_retry_budget_exhaustion_fails_trial_only(tmp_path, data):
+    # A permanent fault (max_fires > budget) exhausts retries: the
+    # trial fails with its attempt history on record; the sweep
+    # continues (resilient) and the healthy trial completes.
+    plan = FaultPlan(specs=(
+        FaultSpec(CRASH, 0, step=2, max_fires=10),
+    ))
+    results = _sweep(
+        [_cfg(0), _cfg(1)], data, tmp_path,
+        fault_plan=plan, retry=RetryPolicy(max_retries=1, backoff_base_s=0.01),
+    )
+    by_id = {r.trial_id: r for r in results}
+    assert by_id[0].status == "failed"
+    assert by_id[0].attempt == 2  # initial + 1 retry
+    assert by_id[1].status == "completed"
+    ends = [e["status"] for e in _events(tmp_path, 0)
+            if e["event"] == "attempt_end"]
+    assert ends == ["retrying", "failed"]
+
+
+def test_resume_integrity_guard_not_defeated_by_retry(tmp_path, data):
+    # The strict-resume config guard is a deliberate hard stop for a
+    # HUMAN; supervision must not classify it infra and scan-retry over
+    # the checkpoint the guard protected.
+    _sweep([_cfg(0, epochs=1, lr=1e-3)], data, tmp_path)
+    ckpt = tmp_path / "trial-0" / "state.msgpack"
+    before = ckpt.read_bytes()
+    # Non-resilient: the guard's ValueError surfaces to the user even
+    # with a retry budget armed.
+    with pytest.raises(ValueError, match="different\\s+hyperparameters"):
+        _sweep(
+            [_cfg(0, epochs=2, lr=5e-3)], data, tmp_path,
+            resume=True, resilient=False,
+        )
+    assert ckpt.read_bytes() == before  # old weights untouched
+    # Resilient: recorded as failed on attempt 1 — no retry consumed,
+    # still no retraining over the guarded checkpoint.
+    (r,) = _sweep(
+        [_cfg(0, epochs=2, lr=5e-3)], data, tmp_path, resume=True
+    )
+    assert r.status == "failed" and r.attempt == 2  # numbering continues
+    assert "different hyperparameters" in r.error
+    assert not _events(tmp_path, 0, "retrying")
+    assert ckpt.read_bytes() == before
+
+
+def test_stacked_bucket_setup_failure_retried(tmp_path, data, monkeypatch):
+    # A transient fault in bucket SETUP (loader init) must consult the
+    # retry budget like the single-trial setup path — not permanently
+    # fail all K member trials.
+    import multidisttorch_tpu.hpo.driver as drv
+
+    real = drv.StackedTrialDataIterator
+    calls = {"n": 0}
+
+    def flaky(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("transient loader init failure")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(drv, "StackedTrialDataIterator", flaky)
+    configs = [_cfg(i, epochs=1) for i in range(3)]
+    results = _sweep(
+        configs, data, tmp_path, stack_trials=True, stack_max_lanes=2
+    )
+    assert calls["n"] >= 2  # first bucket build failed, retry succeeded
+    assert all(r.status == "completed" for r in results)
+
+
+def test_failed_result_reports_executed_steps(tmp_path, data):
+    # A budget-exhausted trial's TrialResult carries the work its final
+    # attempt actually executed, not zero (parity with the diverged
+    # branch; the ledger's progress summaries agree).
+    plan = FaultPlan(specs=(
+        FaultSpec(CRASH, 0, step=STEPS_PER_EPOCH + 2, max_fires=10),
+    ))
+    (r,) = _sweep(
+        [_cfg(0)], data, tmp_path, fault_plan=plan,
+        retry=RetryPolicy(max_retries=1, backoff_base_s=0.01),
+    )
+    assert r.status == "failed"
+    # crashed mid-epoch-2 every attempt: one full epoch + 2 steps ran
+    assert r.steps == STEPS_PER_EPOCH + 2
+    failed_ev = _events(tmp_path, 0, "failed")[0]
+    assert failed_ev["summary"]["steps_at_failure"] == STEPS_PER_EPOCH + 2
+
+
+def test_no_retry_policy_preserves_plain_failure(tmp_path, data):
+    # Without retry= the PR-1 semantics hold: one attempt, failed.
+    plan = FaultPlan(specs=(FaultSpec(CRASH, 0, step=2),))
+    (r,) = _sweep([_cfg(0)], data, tmp_path, fault_plan=plan, retry=None)
+    assert r.status == "failed" and r.attempt == 1
+
+
+def test_corrupt_checkpoint_scanned_past_on_retry(tmp_path, data):
+    # Epoch-2's primary checkpoint rots AFTER its retention copy was
+    # taken; a crash in epoch 3 forces a retry whose scan rejects the
+    # corrupt primary (CRC) and resumes from the epoch-2 version copy
+    # (keep_last=2) — losing nothing but the crashed epoch's partial
+    # work, and staying bit-identical.
+    clean = _sweep([_cfg(0)], data, tmp_path / "clean")[0]
+    plan = FaultPlan(specs=(
+        FaultSpec(CKPT_CORRUPT, 0, epoch=2),
+        FaultSpec(CRASH, 0, step=2 * STEPS_PER_EPOCH + 3),
+    ))
+    (r,) = _sweep(
+        [_cfg(0)], data, tmp_path / "chaos",
+        fault_plan=plan, ckpt_keep_last=2,
+    )
+    assert r.status == "completed" and r.attempt == 2
+    assert r.final_train_loss == clean.final_train_loss
+    done = _events(tmp_path / "chaos", 0, "completed")[0]
+    assert done["summary"]["resumed_from_step"] == 2 * STEPS_PER_EPOCH
+
+
+def test_corrupt_only_checkpoint_retries_from_scratch(tmp_path, data):
+    # keep_last=1 (default): the only checkpoint rots, the scan finds
+    # nothing valid, and recovery degrades to a from-scratch retry —
+    # degraded, never wedged.
+    clean = _sweep([_cfg(0)], data, tmp_path / "clean")[0]
+    plan = FaultPlan(specs=(
+        FaultSpec(CKPT_CORRUPT, 0, epoch=1),
+        FaultSpec(CRASH, 0, step=STEPS_PER_EPOCH + 3),
+    ))
+    (r,) = _sweep([_cfg(0)], data, tmp_path / "chaos", fault_plan=plan)
+    assert r.status == "completed" and r.attempt == 2
+    assert r.final_train_loss == clean.final_train_loss
+    done = _events(tmp_path / "chaos", 0, "completed")[0]
+    assert done["summary"]["resumed_from_step"] == 0
+
+
+def test_preemption_propagates_and_restart_skips_completed(tmp_path, data):
+    # The driver-death half: HostPreemption escapes run_hpo even under
+    # resilient=True; the restarted sweep (same out_dir, resume=True)
+    # skips the ledger-settled trial WITHOUT re-running it and finishes
+    # only the interrupted one.
+    from multidisttorch_tpu.faults.inject import FaultInjector
+
+    plan = FaultPlan(specs=(
+        FaultSpec(PREEMPT, 1, step=STEPS_PER_EPOCH + 2),
+    ))
+    injector = FaultInjector(plan)
+    with pytest.raises(HostPreemption):
+        _sweep([_cfg(0), _cfg(1)], data, tmp_path, fault_plan=injector)
+    # trial 0 settled before the preemption (single group, FIFO order)
+    settled = SweepLedger(str(tmp_path)).finished()
+    assert len(settled) == 1
+
+    results = _sweep(
+        [_cfg(0), _cfg(1)], data, tmp_path,
+        fault_plan=injector, resume=True,
+    )
+    by_id = {r.trial_id: r for r in results}
+    assert by_id[0].status == "resumed_complete"
+    assert by_id[0].attempt == 1  # never re-attempted after restart
+    assert by_id[0].steps == 3 * STEPS_PER_EPOCH
+    assert np.isfinite(by_id[0].final_train_loss)
+    assert by_id[1].status == "completed"
+    # the restarted attempt resumed trial 1 from its epoch-1 checkpoint
+    done = _events(tmp_path, 1, "completed")[0]
+    assert done["summary"]["resumed_from_step"] == STEPS_PER_EPOCH
+    # and the interrupted attempt is on record
+    assert _events(tmp_path, 1, "preempted")
+
+
+def test_restart_reruns_nothing_when_everything_settled(tmp_path, data):
+    _sweep([_cfg(0), _cfg(1)], data, tmp_path)
+    ledger_size = os.path.getsize(tmp_path / LEDGER_NAME)
+    results = _sweep([_cfg(0), _cfg(1)], data, tmp_path, resume=True)
+    assert all(r.status == "resumed_complete" for r in results)
+    assert all(r.steps == 3 * STEPS_PER_EPOCH for r in results)
+    # pure ledger skip: no new attempts were even started
+    starts = [e for e in _events(tmp_path)
+              if e["event"] == "attempt_start"]
+    assert len(starts) == 2
+    assert os.path.getsize(tmp_path / LEDGER_NAME) == ledger_size
+
+
+def test_ledger_tolerates_torn_tail(tmp_path, data):
+    _sweep([_cfg(0)], data, tmp_path)
+    path = tmp_path / LEDGER_NAME
+    with open(path, "a") as f:
+        f.write('{"event": "attempt_end", "trial_id": 0, "config_')  # torn
+    led = SweepLedger(str(tmp_path))
+    assert led.load()  # decodable prefix survives
+    assert len(led.finished()) == 1  # settlement unaffected
+
+
+def test_stacked_lane_fault_retires_and_refills(tmp_path, data):
+    # Lane recovery: a crash scoped to one lane of a stacked bucket
+    # retires that lane through mask-and-refill, the other lanes never
+    # stop, and the retried trial completes from scratch in a refilled
+    # lane. Fault-free lanes stay bit-identical to their own clean run.
+    configs = [_cfg(i, epochs=2) for i in range(5)]
+    clean = {
+        r.trial_id: r
+        for r in _sweep(
+            configs, data, tmp_path / "clean",
+            stack_trials=True, stack_max_lanes=4,
+        )
+    }
+    assert any(r.stacked for r in clean.values())
+    plan = FaultPlan(specs=(FaultSpec(CRASH, 2, step=STEPS_PER_EPOCH + 1),))
+    results = _sweep(
+        configs, data, tmp_path / "chaos",
+        stack_trials=True, stack_max_lanes=4, fault_plan=plan,
+    )
+    by_id = {r.trial_id: r for r in results}
+    assert [by_id[i].status for i in range(5)] == ["completed"] * 5
+    assert by_id[2].attempt == 2
+    assert by_id[2].final_train_loss == clean[2].final_train_loss
+    for i in (0, 1, 3, 4):
+        assert by_id[i].attempt == 1
+        assert by_id[i].final_train_loss == clean[i].final_train_loss
+    assert [e["status"] for e in _events(tmp_path / "chaos", 2)
+            if e["event"] == "attempt_end"] == ["retrying", "completed"]
+
+
+def test_stacked_lane_divergence_is_isolated_and_terminal(tmp_path, data):
+    # NaN-poisoned lane batch: exactly that lane diverges (vmap keeps
+    # lanes independent), the neighbors' losses stay finite and
+    # bit-identical to their clean runs, nothing is retried.
+    configs = [_cfg(i, epochs=2) for i in range(5)]
+    clean = {
+        r.trial_id: r.final_train_loss
+        for r in _sweep(
+            configs, data, tmp_path / "clean",
+            stack_trials=True, stack_max_lanes=4,
+        )
+    }
+    plan = FaultPlan(specs=(FaultSpec(DIVERGE, 1, step=2),))
+    results = _sweep(
+        configs, data, tmp_path / "chaos",
+        stack_trials=True, stack_max_lanes=4, fault_plan=plan,
+    )
+    by_id = {r.trial_id: r for r in results}
+    assert by_id[1].status == "diverged"
+    assert by_id[1].attempt == 1
+    for i in (0, 2, 3, 4):
+        assert by_id[i].status == "completed"
+        assert by_id[i].final_train_loss == clean[i]
+    assert not _events(tmp_path / "chaos", 1, "retrying")
+
+
+def test_backoff_does_not_block_other_trials(tmp_path, data):
+    # Two trials, one group: trial 0 crashes and backs off for a long
+    # window; trial 1 must run during that window, not behind it.
+    import time
+
+    plan = FaultPlan(specs=(FaultSpec(CRASH, 0, step=2),))
+    t0 = time.time()
+    results = _sweep(
+        [_cfg(0, epochs=1), _cfg(1, epochs=1)], data, tmp_path,
+        fault_plan=plan,
+        retry=RetryPolicy(max_retries=1, backoff_base_s=1.5),
+    )
+    wall = time.time() - t0
+    by_id = {r.trial_id: r for r in results}
+    assert by_id[0].status == "completed" and by_id[0].attempt == 2
+    assert by_id[1].status == "completed"
+    # the 1.5s backoff overlapped trial 1's training; the sweep paid it
+    # at most once (not serialized behind every queue scan)
+    assert wall < 30
+
+
+def test_fault_injection_off_is_bit_identical_to_clean(tmp_path, data):
+    # An armed-but-empty injector must not perturb anything: same
+    # losses, same steps, bitwise.
+    clean = _sweep([_cfg(0)], data, tmp_path / "a")[0]
+    armed = _sweep(
+        [_cfg(0)], data, tmp_path / "b", fault_plan=FaultPlan()
+    )[0]
+    assert armed.final_train_loss == clean.final_train_loss
+    assert armed.steps == clean.steps
+
+
+def test_ledger_disabled_writes_nothing(tmp_path, data):
+    _sweep([_cfg(0, epochs=1)], data, tmp_path, ledger=False)
+    assert not os.path.exists(tmp_path / LEDGER_NAME)
+
+
+def test_trial_metrics_json_unchanged_by_supervision(tmp_path, data):
+    # The per-trial metrics.json contract survives the supervision
+    # layer (downstream tooling parses it).
+    plan = FaultPlan(specs=(FaultSpec(CRASH, 0, step=STEPS_PER_EPOCH + 1),))
+    (r,) = _sweep([_cfg(0)], data, tmp_path, fault_plan=plan)
+    with open(os.path.join(r.out_dir, "metrics.json")) as f:
+        metrics = json.load(f)
+    assert metrics["trial_id"] == 0
+    assert len(metrics["history"]) == 3

@@ -1,0 +1,141 @@
+"""Non-finite-loss detection (train/guards.py): a NaN loss surfaces as
+a structured DivergenceError naming the step — in the HPO driver's
+epoch boundary and, via guard_finite, in the non-HPO classifier/LM
+loops — never as a silent garbage metric."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from multidisttorch_tpu.parallel.mesh import setup_groups
+from multidisttorch_tpu.train.guards import (
+    DivergenceError,
+    check_finite,
+    guard_finite,
+)
+
+
+def test_check_finite_passes_and_names_step_on_nan():
+    assert check_finite(1.25, "loss", step=7) == 1.25
+    with pytest.raises(DivergenceError, match=r"step 41"):
+        check_finite(float("nan"), "loss", step=41, trial_id=3)
+    with pytest.raises(DivergenceError, match="trial 3"):
+        check_finite(float("inf"), "loss", step=41, trial_id=3)
+    # Structured fields, not just message text: supervisors classify on
+    # the type and act on the step.
+    try:
+        check_finite(float("nan"), "epoch avg", step=8, trial_id=0)
+    except DivergenceError as e:
+        assert e.step == 8 and e.trial_id == 0 and e.what == "epoch avg"
+
+
+def test_guard_finite_validates_every():
+    with pytest.raises(ValueError, match="every"):
+        guard_finite(lambda s: s, every=0)
+
+
+def test_classifier_nan_loss_raises_divergence_error_naming_step():
+    # Satellite contract: the classifier loop's structured divergence
+    # surface. NaN images drive the real compiled step's loss to NaN;
+    # the guard names the optimizer step.
+    from multidisttorch_tpu.models.resnet import ResNet
+    from multidisttorch_tpu.train.classifier import (
+        create_classifier_state,
+        make_classifier_train_step,
+    )
+
+    model = ResNet(stage_sizes=(1,), base_channels=8, image_hw=16)
+    (trial,) = setup_groups(1)
+    tx = optax.adam(1e-3)
+    state = create_classifier_state(trial, model, tx, jax.random.key(0))
+    step = guard_finite(
+        make_classifier_train_step(trial, model, tx),
+        key="loss",
+        what="classifier train loss",
+    )
+
+    rng = np.random.default_rng(0)
+    good = jnp.asarray(
+        rng.uniform(0, 1, (16, 16 * 16 * 3)).astype(np.float32)
+    )
+    labels = jnp.asarray(rng.integers(0, 10, (16,)).astype(np.int32))
+    state, m = step(state, good, labels)  # healthy step passes through
+    assert np.isfinite(float(m["loss"]))
+
+    bad = jnp.full_like(good, jnp.nan)
+    with pytest.raises(DivergenceError, match=r"step 2") as ei:
+        step(state, bad, labels)
+    assert ei.value.step == 2  # step 1 was the healthy one
+
+
+def test_lm_nan_loss_raises_divergence_error():
+    # Satellite contract: the LM loop's surface. Tokens are ints (can't
+    # carry NaN), so poison the params — the realistic LM divergence
+    # shape (weights blow up, loss follows).
+    from multidisttorch_tpu.models.transformer import TransformerLM
+    from multidisttorch_tpu.train.lm import create_lm_state, make_lm_train_step
+
+    (g,) = setup_groups(1)
+    model = TransformerLM(
+        vocab_size=17, d_model=32, num_heads=2, num_layers=1, max_len=32
+    )
+    tx = optax.adam(1e-3)
+    state = create_lm_state(g, model, tx, jax.random.key(0), example_len=32)
+    step = guard_finite(
+        make_lm_train_step(g, model, tx), key="loss", what="lm train loss"
+    )
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 17, (8, 32)).astype(np.int32)
+    )
+    state, m = step(state, tokens)
+    assert np.isfinite(float(m["loss"]))
+
+    from multidisttorch_tpu.train.steps import TrainState
+
+    poisoned = TrainState(
+        params=jax.tree.map(lambda a: jnp.full_like(a, jnp.nan), state.params),
+        opt_state=state.opt_state,
+        step=state.step,
+    )
+    with pytest.raises(DivergenceError, match="lm train loss"):
+        step(poisoned, tokens)
+
+
+def test_guard_finite_every_n_checks_at_cadence():
+    # every=2: the NaN introduced on call 1 is only *checked* on call 2
+    # — the documented detection-lag/sync trade.
+    calls = []
+
+    class FakeState:
+        def __init__(self, step):
+            self.step = step
+
+    def fake_step(state, loss):
+        calls.append(loss)
+        return FakeState(state.step + 1), {"loss": np.float32(loss)}
+
+    g = guard_finite(fake_step, key="loss", every=2)
+    s = FakeState(0)
+    s, _ = g(s, float("nan"))  # call 1: unchecked by design
+    with pytest.raises(DivergenceError):
+        g(s, float("nan"))  # call 2: checked
+
+def test_guard_finite_fused_metric_names_inner_step():
+    # A scan-fused (K,) loss vector: the first bad entry names the
+    # exact inner optimizer step, not just the dispatch.
+    class FakeState:
+        def __init__(self, step):
+            self.step = step
+
+    def fused_step(state, losses):
+        return FakeState(state.step + len(losses)), {
+            "loss": np.asarray(losses, np.float32)
+        }
+
+    g = guard_finite(fused_step, key="loss")
+    with pytest.raises(DivergenceError) as ei:
+        g(FakeState(10), [1.0, 2.0, float("nan"), 4.0])
+    # steps 11,12,13,14 — the NaN is step 13
+    assert ei.value.step == 13

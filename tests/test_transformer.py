@@ -288,9 +288,13 @@ def test_lm_per_block_remat_gradients_and_losses_match():
             )
         )(params)
 
+    # atol floor sits at a few f32 ULPs of the typical grad magnitude:
+    # XLA:CPU on the pinned jaxlib reassociates the recomputed-forward
+    # reductions up to ~2 ulp (observed max 1.9e-8 on 0.4.36), which the
+    # old 1e-8 floor flagged as a failure.
     jax.tree.map(
         lambda a, b: np.testing.assert_allclose(
-            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-8
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=5e-8
         ),
         jax.device_get(grad_of(plain)),
         jax.device_get(grad_of(remat)),

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Chaos drill CLI: run the standard fault schedule against the HPO
+driver's supervision stack and report recovery + goodput.
+
+    JAX_PLATFORMS=cpu python tools/chaos_run.py \
+        --out artifacts/bench_chaos_cpu.json
+
+Runs entirely on CPU (8 virtual devices) with a CI-sized sweep: every
+infra fault in ``FaultPlan.standard`` must be recovered automatically
+(retry-with-resume, lane refill, ledger restart after the simulated
+preemption), the injected divergence must settle as a terminal
+``diverged`` result, and goodput (useful/executed optimizer steps) is
+the recovery-overhead headline. ``bench.py --chaos`` wraps the same
+protocol (``multidisttorch_tpu/faults/harness.py``) with the bench's
+artifact conventions; this CLI is the standalone, plan-tweakable form.
+
+A custom plan can be drilled with ``--plan my_plan.json`` (the
+``FaultPlan.to_json`` format) — see docs/RESILIENCE.md for how to write
+one.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="deterministic fault-injection drill for run_hpo "
+        "supervision (see docs/RESILIENCE.md)"
+    )
+    parser.add_argument(
+        "--out", default=None,
+        help="write the full JSON report here (default: stdout only)",
+    )
+    parser.add_argument(
+        "--work-dir", default=None,
+        help="sweep scratch dir (default: a fresh temp dir)",
+    )
+    parser.add_argument("--trials", type=int, default=6)
+    parser.add_argument("--epochs", type=int, default=4)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--stacked", action="store_true",
+        help="drill the trial-stacking path instead (lane fault -> "
+        "mask-and-refill recovery; preemption excluded: stacked sweeps "
+        "do not resume)",
+    )
+    parser.add_argument(
+        "--no-preempt", action="store_true",
+        help="skip the simulated host preemption + driver restart",
+    )
+    parser.add_argument(
+        "--plan", default=None,
+        help="drill a custom FaultPlan JSON file (FaultPlan.to_json "
+        "format; trial_ids must be 0..trials-1) instead of the "
+        "standard schedule. Report-only: the goodput >= 0.8 acceptance "
+        "gate applies to the standard schedule only",
+    )
+    args = parser.parse_args()
+
+    # 8 virtual CPU devices (the test harness topology) so 2 submesh
+    # groups exist even on a laptop; must land before backend init.
+    if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""
+    ):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        )
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from multidisttorch_tpu.faults.harness import run_chaos_bench
+
+    work_dir = args.work_dir or tempfile.mkdtemp(prefix="chaos_run_")
+
+    plan = None
+    if args.plan is not None:
+        from multidisttorch_tpu.faults.plan import FaultPlan
+
+        with open(args.plan) as f:
+            plan = FaultPlan.from_json(f.read())
+        bad_ids = {
+            s.trial_id for s in plan.specs
+        } - set(range(args.trials))
+        if bad_ids:
+            parser.error(
+                f"--plan targets trial ids {sorted(bad_ids)} outside this "
+                f"sweep's 0..{args.trials - 1} (adjust --trials or the plan)"
+            )
+
+    report = run_chaos_bench(
+        work_dir,
+        trials=args.trials,
+        epochs=args.epochs,
+        seed=args.seed,
+        include_preempt=not args.no_preempt,
+        stacked=args.stacked,
+        plan=plan,
+    )
+
+    ok = (
+        report["all_infra_faults_recovered"]
+        and report["final_metrics_bit_identical"]
+        # the goodput bar is the STANDARD schedule's acceptance; a
+        # custom plan is report-only there (its author owns the bar)
+        and (plan is not None or report["goodput"] >= 0.8)
+    )
+    headline = {
+        "metric": "chaos_goodput_useful_over_executed_steps",
+        "value": report["goodput"],
+        "unit": "fraction",
+        "vs_baseline": round(report["goodput"] / 0.8, 3),
+        "all_infra_faults_recovered": report["all_infra_faults_recovered"],
+        "final_metrics_bit_identical": report["final_metrics_bit_identical"],
+        "restarts_after_preemption": report["restarts_after_preemption"],
+        "detail": report,
+    }
+    print(json.dumps(headline))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(headline, f, indent=2)
+        os.replace(tmp, args.out)
+        print(f"report written to {args.out}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
