@@ -68,6 +68,7 @@ def run_chaos_bench(
     data_rows: int = 128,
     stacked: bool = False,
     plan: "FaultPlan | None" = None,
+    telemetry_dir: "str | None" = None,
 ) -> dict:
     """Execute the standard fault schedule and return the report dict.
 
@@ -81,6 +82,15 @@ def run_chaos_bench(
     trials, ``0..trials-1``); the report's recovery/parity/goodput math
     is identical, but the 0.8 goodput acceptance is the STANDARD
     schedule's contract — custom-plan callers decide their own bar.
+
+    The chaos run (never the fault-free reference — its timings stay
+    clean) executes under telemetry (docs/OBSERVABILITY.md): events
+    stream to ``telemetry_dir`` (default ``{work_dir}/telemetry``), and
+    the report's ``telemetry`` block carries the exported Perfetto
+    trace/Prometheus/summary paths plus the cross-check that every
+    fired fault, scheduled retry, and lane refill appears as a tagged
+    event in the trace. The driver-restart loop lives INSIDE the
+    telemetry scope, so one timeline spans every preemption restart.
     """
     import os
     import shutil
@@ -121,32 +131,39 @@ def run_chaos_bench(
     chaos_dir = os.path.join(work_dir, "chaos")
     retry = RetryPolicy(max_retries=2, backoff_base_s=0.01)
     restarts = 0
+    tel_dir = telemetry_dir or os.path.join(work_dir, "telemetry")
+    from multidisttorch_tpu import telemetry
+
     t0 = time.time()
-    while True:
-        try:
-            results = run_hpo(
-                configs, train, None, **_sweep_kwargs(chaos_dir),
-                resilient=True,
-                retry=retry,
-                fault_plan=injector,
-                resume=restarts > 0,
-                ckpt_keep_last=2,
-                stack_trials=stacked,
-            )
-            break
-        except HostPreemption:
-            # The simulated host died mid-sweep. A real deployment
-            # restarts the driver process; here the restart reuses the
-            # injector (fired faults stay fired) and the on-disk ledger
-            # + checkpoints do the rest.
-            restarts += 1
-            if restarts > MAX_RESTARTS:
-                raise RuntimeError(
-                    f"chaos harness: >{MAX_RESTARTS} preemption restarts "
-                    "— the plan should bound preemptions; supervision is "
-                    "not converging"
+    with telemetry.telemetry_run(tel_dir):
+        while True:
+            try:
+                results = run_hpo(
+                    configs, train, None, **_sweep_kwargs(chaos_dir),
+                    resilient=True,
+                    retry=retry,
+                    fault_plan=injector,
+                    resume=restarts > 0,
+                    ckpt_keep_last=2,
+                    stack_trials=stacked,
                 )
-    wall_chaos = time.time() - t0
+                break
+            except HostPreemption:
+                # The simulated host died mid-sweep. A real deployment
+                # restarts the driver process; here the restart reuses
+                # the injector (fired faults stay fired) and the
+                # on-disk ledger + checkpoints do the rest.
+                restarts += 1
+                if restarts > MAX_RESTARTS:
+                    raise RuntimeError(
+                        f"chaos harness: >{MAX_RESTARTS} preemption "
+                        "restarts — the plan should bound preemptions; "
+                        "supervision is not converging"
+                    )
+        # Wall clock closes BEFORE the export: the fault-free reference
+        # pays no export cost, so wall_ratio must not charge it here.
+        wall_chaos = time.time() - t0
+        telemetry_report = _export_telemetry(tel_dir, injector)
 
     # --- accounting -------------------------------------------------
     by_id = {r.trial_id: r for r in results}
@@ -212,6 +229,61 @@ def run_chaos_bench(
         "final_metrics_bit_identical": all_parity,
         "parity": parity,
         "statuses": {r.trial_id: r.status for r in results},
+        "telemetry": telemetry_report,
+    }
+
+
+def _export_telemetry(tel_dir: str, injector: FaultInjector) -> dict:
+    """Export the chaos run's trace/metrics/summary and cross-check the
+    event stream against the injector's ground truth: every fired fault
+    must appear as a tagged ``fault_injected`` event, and the trace must
+    carry the sweep's retries and lane refills. Called INSIDE the
+    telemetry scope (the registry is still live for the Prometheus
+    dump)."""
+    import json
+    import os
+
+    from multidisttorch_tpu.telemetry import EVENTS_NAME, export, read_events
+
+    events = read_events(os.path.join(tel_dir, EVENTS_NAME))
+    paths = export.export_all(tel_dir, events)
+
+    def count(kind: str, **match) -> int:
+        n = 0
+        for ev in events:
+            if ev.get("kind") != kind:
+                continue
+            data = ev.get("data") or {}
+            if all(data.get(k) == v or ev.get(k) == v
+                   for k, v in match.items()):
+                n += 1
+        return n
+
+    fired_traced = all(
+        count(
+            "fault_injected", fault_kind=rec["kind"],
+            trial_id=rec["trial_id"],
+        ) > 0
+        for rec in injector.fired
+    )
+    with open(paths["trace"]) as f:
+        trace = json.load(f)  # loads == Perfetto-parseable JSON
+    # Monotonicity is checked on the RAW event stream (emission order),
+    # not the trace — build_trace sorts its output, so checking the
+    # trace would pass by construction.
+    raw_ts = [float(e.get("ts", 0.0)) for e in events]
+    return {
+        "dir": tel_dir,
+        **paths,
+        "events_recorded": len(events),
+        "faults_fired": len(injector.fired),
+        "faults_traced": count("fault_injected"),
+        "all_faults_traced": fired_traced,
+        "retries_traced": count("retry_scheduled")
+        + count("lane_fault", retrying=True),
+        "lane_refills_traced": count("lane_refill"),
+        "trace_monotonic": raw_ts == sorted(raw_ts)
+        and bool(trace.get("traceEvents")),
     }
 
 

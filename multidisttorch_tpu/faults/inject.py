@@ -93,6 +93,20 @@ class FaultInjector:
                 {"kind": spec.kind, "trial_id": spec.trial_id, **ctx,
                  "ts": time.time()}
             )
+        # Telemetry seam: every fired fault tags itself into the event
+        # stream, so a chaos run's trace self-documents its injections
+        # next to the recovery they triggered.
+        from multidisttorch_tpu.telemetry.events import get_bus
+
+        bus = get_bus()
+        if bus is not None:
+            bus.emit(
+                "fault_injected",
+                trial_id=spec.trial_id,
+                step=ctx.get("step"),
+                fault_kind=spec.kind,
+                **{k: v for k, v in ctx.items() if k != "step"},
+            )
 
     def _match(
         self,

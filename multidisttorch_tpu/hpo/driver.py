@@ -38,6 +38,7 @@ the one it belongs to, and runs a DDP trial whose only hyperparameter is
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
@@ -90,8 +91,10 @@ from multidisttorch_tpu.train.steps import (
     state_shardings,
     wrap_step_with_hooks,
 )
+from multidisttorch_tpu.telemetry.events import get_bus
+from multidisttorch_tpu.telemetry.metrics import get_registry
 from multidisttorch_tpu.utils.imaging import save_image_grid
-from multidisttorch_tpu.utils.logging import log0
+from multidisttorch_tpu.utils.logging import log0, log0_enabled
 
 
 @dataclass(frozen=True)
@@ -306,6 +309,12 @@ class _TrialRun:
         # compiled-step wrappers) always see the current step.
         self._step_no = 0
         self._epoch_base_step = 0
+        # Telemetry (both None when off — the zero-cost contract;
+        # captured once so the hot loop pays one attribute read).
+        # Step timings flow into the sweep-wide metrics registry under
+        # this trial's series key; lifecycle events ride the bus.
+        self._mreg = get_registry()
+        self._mkey = f"trial-{cfg.trial_id}"
 
         if model_builder is None:
             model = VAE(hidden_dim=cfg.hidden_dim, latent_dim=cfg.latent_dim)
@@ -539,9 +548,9 @@ class _TrialRun:
             self.cfg.trial_id, self._epoch_base_step + batch_index
         )
 
-    def _log(self, *args):
+    def _log(self, *args, level: int = logging.INFO):
         if self._verbose:
-            log0(*args, trial=self.trial)
+            log0(*args, trial=self.trial, level=level)
 
     @contextmanager
     def _guard(self):
@@ -655,7 +664,10 @@ class _TrialRun:
             epoch_sum_dev = None
 
             def log_batch(epoch, i, loss_sum):
-                if not self._verbose:
+                # Per-STEP chatter rides DEBUG (per-trial lines stay
+                # INFO): a sweep that raises the logger level skips the
+                # device sync below entirely, not just the print.
+                if not self._verbose or not log0_enabled(logging.DEBUG):
                     return  # don't pay the device sync for a dropped line
                 # sync point for THIS trial only (reference logs
                 # loss.item() here, vae-hpo.py:76-86)
@@ -668,7 +680,8 @@ class _TrialRun:
                         n_per_epoch,
                         100.0 * i / self.train_iter.num_batches,
                         per_sample,
-                    )
+                    ),
+                    level=logging.DEBUG,
                 )
 
             if self.multi_step is None:
@@ -680,6 +693,8 @@ class _TrialRun:
                     self._step_no += 1
                     s = metrics["loss_sum"]  # on device, async
                     epoch_sum_dev = s if epoch_sum_dev is None else epoch_sum_dev + s
+                    if self._mreg is not None:
+                        self._mreg.step_mark(self._mkey, s)
                     if i % cfg.log_interval == 0:
                         log_batch(epoch, i, metrics["loss_sum"])
                     yield  # hand the host loop to the next trial
@@ -703,6 +718,8 @@ class _TrialRun:
                         epoch_sum_dev = (
                             s if epoch_sum_dev is None else epoch_sum_dev + s
                         )
+                        if self._mreg is not None:
+                            self._mreg.step_mark(self._mkey, s, steps=c)
                         # Every batch index that would have logged in the
                         # per-step loop still logs (there can be several
                         # per chunk when log_interval < fused_steps).
@@ -725,6 +742,8 @@ class _TrialRun:
                                 if epoch_sum_dev is None
                                 else epoch_sum_dev + s
                             )
+                            if self._mreg is not None:
+                                self._mreg.step_mark(self._mkey, s)
                             if (i0 + j) % cfg.log_interval == 0:
                                 log_batch(epoch, i0 + j, metrics["loss_sum"])
                     yield
@@ -827,6 +846,15 @@ class _TrialRun:
 
             self.result.history.append(epoch_record)
             self.result.final_train_loss = avg
+            bus = get_bus()
+            if bus is not None:
+                bus.emit(
+                    "epoch",
+                    trial_id=cfg.trial_id,
+                    group_id=self.trial.group_id,
+                    step=self._step_no,
+                    **epoch_record,
+                )
             if self._save_checkpoint:
                 # Sharded states gather to replicated first — dispatched
                 # on ALL owners (uniform program; a writer-local gather
@@ -997,6 +1025,12 @@ class _StackedBucketRun:
         self._chashes = chashes if chashes is not None else {}
         self._infra_fails = infra_fails if infra_fails is not None else {}
         self._round_step0: dict[int, int] = {}
+        # Telemetry: stacked step timings are attributed to the BUCKET
+        # (one series per group's bucket, lanes= tagging the live lane
+        # count), never to a single lane — the per-lane effective rate
+        # is derived in the registry (telemetry.metrics.StepSeries).
+        self._mreg = get_registry()
+        self._mkey = f"bucket-g{trial.group_id}"
 
         self.model = VAE(
             hidden_dim=template.hidden_dim, latent_dim=template.latent_dim
@@ -1082,9 +1116,21 @@ class _StackedBucketRun:
             [lane["steps"] if lane else 0 for lane in self.lanes], jnp.int32
         )
 
-    def _log(self, *args):
+    def _log(self, *args, level: int = logging.INFO):
         if self._verbose:
-            log0(*args, trial=self.trial)
+            log0(*args, trial=self.trial, level=level)
+
+    def _emit_lane(self, kind: str, lane_k: int, trial_id=None, **data):
+        """Lane-churn telemetry (retire/refill/fault/diverge/mask)."""
+        bus = get_bus()
+        if bus is not None:
+            bus.emit(
+                kind,
+                trial_id=trial_id,
+                lane=lane_k,
+                group_id=self.trial.group_id,
+                **data,
+            )
 
     def _bump_steps(self, n: int) -> None:
         for lane in self.lanes:
@@ -1204,7 +1250,19 @@ class _StackedBucketRun:
         error_text = f"{type(exc).__name__}: {exc}"
         fails = self._infra_fails[idx] = self._infra_fails.get(idx, 0) + 1
         progress = {"resumed_from_step": 0, "steps_at_failure": lane["steps"]}
-        if self._retry is not None and self._retry.should_retry(fails, INFRA):
+        retrying = self._retry is not None and self._retry.should_retry(
+            fails, INFRA
+        )
+        self._emit_lane(
+            "lane_fault",
+            k,
+            trial_id=cfg.trial_id,
+            step=lane["steps"],
+            error=error_text,
+            infra_failures=fails,
+            retrying=retrying,
+        )
+        if retrying:
             self._note_attempt_end(
                 lane, "retrying", error=error_text, summary=progress
             )
@@ -1272,6 +1330,13 @@ class _StackedBucketRun:
         self._note_attempt_end(
             lane, "diverged", error=str(err),
             summary=_result_summary(result),
+        )
+        self._emit_lane(
+            "lane_diverge",
+            k,
+            trial_id=cfg.trial_id,
+            step=lane["steps"],
+            avg_train_loss=avg,
         )
         self._log(
             f"Trial {cfg.trial_id} DIVERGED (stacked lane {k}, "
@@ -1341,6 +1406,14 @@ class _StackedBucketRun:
         self._note_attempt_end(
             lane, "completed", summary=_result_summary(result)
         )
+        self._emit_lane(
+            "lane_retire",
+            k,
+            trial_id=cfg.trial_id,
+            step=lane["steps"],
+            epochs=lane["epochs_done"],
+            wall_s=round(result.wall_s, 6),
+        )
         self._log(
             f"Trial {cfg.trial_id} done (stacked lane {k}). "
             f"time: {result.wall_s:f}"
@@ -1362,12 +1435,14 @@ class _StackedBucketRun:
                 np.int32(k),
             )
             self.data.set_lane(k, nxt.seed)
+            self._emit_lane("lane_refill", k, trial_id=nxt.trial_id)
             self._log(
                 f"Trial {nxt.trial_id} refilled into stacked lane {k} "
                 "(no recompilation)"
             )
         else:
             self.lanes[k] = None  # masked out by active=0.0
+            self._emit_lane("lane_masked", k)
         self._refresh_lane_arrays()
 
     def unfinished(self) -> list[tuple[int, TrialConfig]]:
@@ -1397,6 +1472,10 @@ class _StackedBucketRun:
                 if lane is not None
             }
             round_sum_dev = None  # (K,) on-device
+            # Live lane count at round start: lanes only change at
+            # round boundaries, so this tags every dispatch's metrics
+            # mark with the bucket's true occupancy.
+            k_live = sum(lane is not None for lane in self.lanes)
 
             def add(dev_sums):
                 nonlocal round_sum_dev
@@ -1414,6 +1493,10 @@ class _StackedBucketRun:
                     )
                     self._bump_steps(1)
                     add(m["loss_sum"])
+                    if self._mreg is not None:
+                        self._mreg.step_mark(
+                            self._mkey, round_sum_dev, lanes=k_live
+                        )
                     yield
             else:
                 for start, chunk in self.data.round_chunks(self.fused):
@@ -1425,6 +1508,11 @@ class _StackedBucketRun:
                         )
                         self._bump_steps(s)
                         add(m["loss_sum"].sum(axis=0))
+                        if self._mreg is not None:
+                            self._mreg.step_mark(
+                                self._mkey, round_sum_dev,
+                                steps=s, lanes=k_live,
+                            )
                     else:
                         # Tail shorter than the compiled chunk: per-step
                         # stacked dispatches (no extra compilation).
@@ -1435,6 +1523,10 @@ class _StackedBucketRun:
                             )
                             self._bump_steps(1)
                             add(m["loss_sum"])
+                            if self._mreg is not None:
+                                self._mreg.step_mark(
+                                    self._mkey, round_sum_dev, lanes=k_live
+                                )
                     yield
 
             # One fetch for every lane's epoch average (O(1)-syncs rule:
@@ -1484,6 +1576,16 @@ class _StackedBucketRun:
                         )
                     )
                 lane["history"].append(record)
+                bus = get_bus()
+                if bus is not None:
+                    bus.emit(
+                        "epoch",
+                        trial_id=lane["cfg"].trial_id,
+                        lane=k,
+                        group_id=self.trial.group_id,
+                        step=lane["steps"],
+                        **record,
+                    )
                 if lane["epochs_done"] >= lane["cfg"].epochs:
                     retiring.append(k)
             for k in diverged:
@@ -1710,6 +1812,11 @@ def _run_hpo_body(
     ckpt_keep_last=1,
     agree_timeout_s=None,
 ) -> list[TrialResult]:
+    # Telemetry opt-in by environment (MDT_TELEMETRY[_DIR]) — a no-op
+    # env read when off, and an explicit telemetry.configure() wins.
+    from multidisttorch_tpu import telemetry as _telemetry
+
+    _telemetry.configure_from_env()
     if groups is None:
         groups = setup_groups(
             num_groups if num_groups is not None else len(configs),
@@ -1923,6 +2030,7 @@ def _run_hpo_body(
         # Don't idle submeshes behind one mega-bucket: split the largest
         # bucket until there is at least one work item per group (or
         # nothing left to split).
+        bus = get_bus()
         while len(items) < len(groups):
             big = max(
                 (it for it in items if it[0] == "bucket" and len(it[1]) >= 4),
@@ -1935,8 +2043,30 @@ def _run_hpo_body(
             half = len(big[1]) // 2
             items.append(("bucket", big[1][:half]))
             items.append(("bucket", big[1][half:]))
+            if bus is not None:
+                bus.emit(
+                    "stack_split",
+                    members=[cfg.trial_id for _, cfg in big[1]],
+                    split_at=half,
+                )
         # Deterministic order: by first member's config index.
         items.sort(key=lambda it: it[1][0][0])
+        if bus is not None:
+            # Stacking decisions are telemetry: which trials share a
+            # compiled program (and which ran classic) explains every
+            # downstream lane event and throughput number.
+            for kind_, members in items:
+                if kind_ == "bucket":
+                    bus.emit(
+                        "stack_bucket",
+                        members=[cfg.trial_id for _, cfg in members],
+                        bucket_key=str(stack_bucket_key(members[0][1])),
+                    )
+            bus.emit(
+                "stack_plan",
+                buckets=sum(1 for it in items if it[0] == "bucket"),
+                singles=sum(1 for it in items if it[0] == "single"),
+            )
         return items
 
     # Queue items are (kind, members, ready_at): "single"/"retry" carry
@@ -2013,6 +2143,16 @@ def _run_hpo_body(
         # controller retries requeue immediately (FIFO order is shared
         # state; clocks are not).
         delay = retry.backoff_s(fails) if single else 0.0
+        bus = get_bus()
+        if bus is not None:
+            bus.emit(
+                "retry_scheduled",
+                trial_id=cfg.trial_id,
+                group_id=g.group_id,
+                backoff_s=delay,
+                infra_failures=fails,
+                error=error_text,
+            )
         led.attempt_end(
             cfg.trial_id, chashes[i], attempts[i], "retrying",
             error=error_text, summary=progress,
@@ -2071,7 +2211,11 @@ def _run_hpo_body(
                     )
                 except Exception as e:  # noqa: BLE001 — setup isolation
                     error_text = f"{type(e).__name__}: {e}"
-                    if classify_failure(e) == PREEMPTION:
+                    # Classified ONCE per failure: classification also
+                    # emits the failure_classified telemetry event, and
+                    # re-calling would duplicate it in the stream.
+                    setup_class = classify_failure(e)
+                    if setup_class == PREEMPTION:
                         # The host (or a peer) is gone: even resilient
                         # sweeps stop; the ledger sees every in-flight
                         # attempt before the driver dies.
@@ -2091,7 +2235,7 @@ def _run_hpo_body(
                     )
                     if (
                         retry is not None
-                        and classify_failure(e) == INFRA
+                        and setup_class == INFRA
                         and retry.should_retry(fails, INFRA)
                     ):
                         delay = retry.backoff_s(fails) if single else 0.0
@@ -2189,6 +2333,18 @@ def _run_hpo_body(
             active[g.group_id] = ("single", i, run, run.run())
             return True
         return False
+
+    bus = get_bus()
+    if bus is not None:
+        bus.emit(
+            "sweep_start",
+            configs=len(configs),
+            groups=len(groups),
+            stacked=bool(stack_trials),
+            resume=bool(resume),
+            resilient=bool(resilient),
+            skipped_settled=len(skipped),
+        )
 
     for g in local_groups:
         start_next(g)
@@ -2343,4 +2499,10 @@ def _run_hpo_body(
                     trial=g,
                 )
                 start_next(g)
+    bus = get_bus()
+    if bus is not None:
+        statuses: dict[str, int] = {}
+        for r in results.values():
+            statuses[r.status] = statuses.get(r.status, 0) + 1
+        bus.emit("sweep_end", results=len(results), statuses=statuses)
     return [results[i] for i in sorted(results)]

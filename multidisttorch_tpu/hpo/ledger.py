@@ -66,6 +66,21 @@ class SweepLedger:
     def attempt_start(
         self, trial_id: int, chash: str, attempt: int
     ) -> None:
+        # Telemetry rides the ledger's call sites: every attempt
+        # boundary in the driver (classic AND stacked-lane paths)
+        # already funnels through these two methods, so emitting here —
+        # BEFORE the write gate, which only controls the durable file —
+        # observes attempts even when the ledger file itself is off.
+        from multidisttorch_tpu.telemetry.events import get_bus
+
+        bus = get_bus()
+        if bus is not None:
+            bus.emit(
+                "attempt_start",
+                trial_id=trial_id,
+                attempt=attempt,
+                config_hash=chash,
+            )
         self.append(
             {
                 "event": "attempt_start",
@@ -88,6 +103,35 @@ class SweepLedger:
         """``status``: completed | diverged | retrying | failed |
         preempted. ``summary`` (completed/diverged) carries enough to
         reconstruct the TrialResult on a ledger skip."""
+        from multidisttorch_tpu.hpo.supervision import SETTLED_STATUSES
+        from multidisttorch_tpu.telemetry.events import get_bus
+        from multidisttorch_tpu.telemetry.metrics import get_registry
+
+        bus = get_bus()
+        if bus is not None:
+            bus.emit(
+                "attempt_end",
+                trial_id=trial_id,
+                attempt=attempt,
+                config_hash=chash,
+                status=status,
+                error=error,
+                summary=summary or {},
+            )
+        reg = get_registry()
+        if reg is not None:
+            # The goodput books, live: executed counts every attempt's
+            # (end - resume) steps; useful counts settled outcomes only
+            # — same math as the chaos bench and the run summary.
+            reg.counter("attempts_total", status=status).inc()
+            s = summary or {}
+            done = int(s.get("steps", s.get("steps_at_failure", 0)) or 0)
+            resumed = int(s.get("resumed_from_step", 0) or 0)
+            reg.counter("executed_steps_total").inc(max(0, done - resumed))
+            if status in SETTLED_STATUSES:
+                reg.counter("useful_steps_total").inc(done)
+            if status == "retrying":
+                reg.counter("retries_total").inc()
         self.append(
             {
                 "event": "attempt_end",
@@ -124,14 +168,16 @@ class SweepLedger:
         whose outcome is settled (completed or diverged — the statuses a
         restarted sweep must NOT re-run). A later attempt_start for the
         same hash (a forced re-run) invalidates the earlier settlement."""
+        from multidisttorch_tpu.hpo.supervision import SETTLED_STATUSES
+
         done: dict[str, dict] = {}
         for ev in self.load():
             h = ev.get("config_hash")
             if not h:
                 continue
-            if ev.get("event") == "attempt_end" and ev.get("status") in (
-                "completed",
-                "diverged",
+            if (
+                ev.get("event") == "attempt_end"
+                and ev.get("status") in SETTLED_STATUSES
             ):
                 done[h] = ev
             elif ev.get("event") == "attempt_start" and h in done:

@@ -36,6 +36,13 @@ DIVERGENCE = "divergence"
 PREEMPTION = "preemption"
 FATAL = "fatal"
 
+# Attempt-end statuses that SETTLE a trial: its executed steps become
+# USEFUL work in the goodput accounting, and a restarted sweep must not
+# re-run it (hpo/ledger.py's skip contract). The single definition the
+# ledger, the telemetry fold (telemetry/export.py), and the chaos
+# harness all share — supervision owns the status taxonomy.
+SETTLED_STATUSES = ("completed", "diverged")
+
 
 class UnretryableError(ValueError):
     """A deliberate hard stop that retrying would only paper over.
@@ -53,6 +60,24 @@ class UnretryableError(ValueError):
 
 def classify_failure(exc: BaseException) -> str:
     """Map an attempt's exception to its supervision class."""
+    cls = _classify(exc)
+    # Telemetry seam: every classification decision is an event, so a
+    # chaos trace shows not just that a fault fired but what the
+    # supervisor decided to DO about it (docs/OBSERVABILITY.md).
+    from multidisttorch_tpu.telemetry.events import get_bus
+
+    bus = get_bus()
+    if bus is not None:
+        bus.emit(
+            "failure_classified",
+            failure_class=cls,
+            exc_type=type(exc).__name__,
+            error=str(exc)[:300],
+        )
+    return cls
+
+
+def _classify(exc: BaseException) -> str:
     from multidisttorch_tpu.faults.inject import HostPreemption
 
     if isinstance(exc, DivergenceError):

@@ -148,9 +148,12 @@ def group_all_ok(
     should treat the group as lost and restart against the sweep
     ledger). ``None``/0 = unbounded, the pre-timeout behavior.
     """
+    import time
+
     import numpy as np
 
     from multidisttorch_tpu.parallel.cluster import call_with_timeout
+    from multidisttorch_tpu.telemetry.events import get_bus
 
     def agree() -> bool:
         n = trial.size
@@ -169,4 +172,29 @@ def group_all_ok(
         failed = _sum_flags_fn(trial.mesh)(flags)
         return float(failed) == 0.0
 
-    return call_with_timeout(agree, timeout_s, what)
+    bus = get_bus()
+    if bus is None:
+        return call_with_timeout(agree, timeout_s, what)
+    # Telemetry seam: agreement latency is the sweep's cross-process
+    # sync cost — a slow peer shows up here long before it times out.
+    t0 = time.perf_counter()
+    try:
+        agreed = call_with_timeout(agree, timeout_s, what)
+    except BaseException as e:
+        bus.emit(
+            "agreement",
+            group_id=trial.group_id,
+            what=what,
+            outcome=f"error: {type(e).__name__}",
+            wall_s=round(time.perf_counter() - t0, 6),
+        )
+        raise
+    bus.emit(
+        "agreement",
+        group_id=trial.group_id,
+        what=what,
+        outcome="agreed" if agreed else "peer_failure",
+        local_ok=ok,
+        wall_s=round(time.perf_counter() - t0, 6),
+    )
+    return agreed

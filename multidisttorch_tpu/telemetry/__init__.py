@@ -1,0 +1,132 @@
+"""Sweep-wide telemetry: structured events, metrics, exporters.
+
+The reference's only instrumentation is one group-aware print per trial
+(``/root/reference/utils.py:165-174``); after trial stacking (PR 1) and
+chaos supervision (PR 2) a sweep has rich internal dynamics — lane
+retirements, backoff retries, checkpoint scan-backs, goodput — that were
+invisible outside ad-hoc prints. This package makes them first-class:
+
+- :mod:`~multidisttorch_tpu.telemetry.events` — a process-local typed
+  **event bus** with a bounded in-memory queue and an append-only JSONL
+  sink (torn-tail tolerant, like the sweep ledger). The driver,
+  supervision, checkpoint, fault-injection, and collectives layers all
+  emit through it — host-side seams only, never inside traced code.
+- :mod:`~multidisttorch_tpu.telemetry.metrics` — counters, gauges,
+  fixed-bucket histograms; per-trial/per-bucket step timing with sparse
+  device-inclusive sampling; compile accounting.
+- :mod:`~multidisttorch_tpu.telemetry.export` — Chrome/Perfetto trace
+  JSON (one track per trial), a Prometheus-style text dump, and a
+  run-summary JSON that ``bench.py`` embeds in its artifacts.
+- ``tools/sweep_top.py`` — live console over the event JSONL.
+
+**Zero-cost-when-off contract**: telemetry is DISABLED by default.
+Every hot-path seam is written as ``bus = get_bus(); if bus is not
+None: bus.emit(...)`` — with telemetry off, ``get_bus()`` returns
+``None`` and *no event object is ever constructed* (regression-tested
+in tests/test_telemetry.py). When on, the budget is <= 2% step-time
+overhead, enforced by ``bench.py --stacked``'s telemetry A/B block.
+
+Enable programmatically::
+
+    from multidisttorch_tpu import telemetry
+    with telemetry.telemetry_run("out/telemetry"):
+        run_hpo(...)
+
+or by environment (picked up at sweep start): ``MDT_TELEMETRY=1``
+[+ ``MDT_TELEMETRY_DIR=<dir>``].
+
+See docs/OBSERVABILITY.md for the event taxonomy and metrics catalog.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+from multidisttorch_tpu.telemetry import events as _events
+from multidisttorch_tpu.telemetry import metrics as _metrics
+
+get_bus = _events.get_bus
+get_registry = _metrics.get_registry
+read_events = _events.read_events
+EVENTS_NAME = _events.EVENTS_NAME
+
+
+def enabled() -> bool:
+    """Whether telemetry is currently on (bus exists)."""
+    return _events.get_bus() is not None
+
+
+def configure(
+    out_dir: Optional[str] = None,
+    *,
+    queue_max: int = 4096,
+    device_sample_every: int = 100,
+) -> None:
+    """Turn telemetry ON: create the event bus (JSONL sink under
+    ``out_dir`` when given, in-memory only otherwise) and the metrics
+    registry, and install the best-effort compile listener."""
+    path = None
+    if out_dir is not None:
+        os.makedirs(out_dir, exist_ok=True)
+        name = _events.EVENTS_NAME
+        # Multi-controller: every process emits (agreements, writer-
+        # gated checkpoint saves, ...) and the dir is typically a
+        # shared filesystem — independent handles on ONE file would
+        # interleave and overwrite each other's bytes. One sink per
+        # process; tools read the per-process streams individually.
+        import jax
+
+        if jax.process_count() > 1:
+            name = f"events.p{jax.process_index()}.jsonl"
+        path = os.path.join(out_dir, name)
+    _events.configure(path=path, queue_max=queue_max)
+    _metrics.configure(device_sample_every=device_sample_every)
+    _metrics.install_compile_listener()
+
+
+def disable() -> None:
+    """Turn telemetry OFF (close the sink, drop bus and registry)."""
+    _events.disable()
+    _metrics.disable()
+
+
+def configure_from_env() -> bool:
+    """Enable telemetry when ``MDT_TELEMETRY`` is truthy (dir from
+    ``MDT_TELEMETRY_DIR``, default ``telemetry/``). Called once at sweep
+    start by the HPO driver; a no-op (cheap env read) otherwise.
+    Already-configured telemetry is left alone — an explicit
+    :func:`configure` wins over the env."""
+    if enabled():
+        return True
+    flag = os.environ.get("MDT_TELEMETRY", "").strip().lower()
+    if flag in ("", "0", "false", "off"):
+        return False
+    configure(os.environ.get("MDT_TELEMETRY_DIR", "telemetry"))
+    return True
+
+
+@contextlib.contextmanager
+def telemetry_run(out_dir: Optional[str] = None, **kwargs):
+    """Scope telemetry to a block: configure on entry, disable on exit
+    (restoring a previously-active configuration is deliberately not
+    attempted — nesting telemetry runs is not a supported shape)."""
+    configure(out_dir, **kwargs)
+    try:
+        yield _events.get_bus()
+    finally:
+        disable()
+
+
+__all__ = [
+    "EVENTS_NAME",
+    "configure",
+    "configure_from_env",
+    "disable",
+    "enabled",
+    "get_bus",
+    "get_registry",
+    "read_events",
+    "telemetry_run",
+]

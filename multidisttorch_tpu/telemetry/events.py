@@ -1,0 +1,208 @@
+"""Process-local structured event bus with a durable JSONL sink.
+
+One :class:`Event` per interesting host-side occurrence — trial
+lifecycle, stacking decisions, lane retire/refill, failure
+classification, retry scheduling, checkpoint save/restore/scan-back,
+injected faults, collective agreements. Events are typed (``kind``),
+wall-clock timestamped, and tagged with whatever identity the seam
+knows (``trial_id`` / ``lane`` / ``attempt`` / ``step`` / ``group_id``);
+free-form payload rides in ``data``.
+
+Durability model mirrors the sweep ledger (``hpo/ledger.py``): the sink
+is an append-only JSONL file (truncated at :func:`configure` — one run
+per file, so re-runs never mix streams), one event per line, flushed
+per append
+(no fsync — telemetry is observability, not control state; losing the
+tail on a crash is acceptable where losing a ledger line is not).
+:func:`read_events` skips undecodable lines, so a torn tail costs at
+most the final event.
+
+The in-memory side is a BOUNDED ring: the newest ``queue_max`` events
+stay addressable for in-process consumers (run summaries, tests);
+overflow drops the OLDEST and counts the drops (``Bus.dropped``) — a
+telemetry flood must never grow host memory without bound or stall the
+dispatch loop.
+
+Zero-cost-when-off: module state holds ``None`` until
+:func:`configure`; every emit seam in the codebase guards with
+``bus = get_bus();  if bus is not None: bus.emit(...)`` so the off path
+is one global read — no :class:`Event` is ever constructed
+(tests/test_telemetry.py enforces this on the driver's hot paths).
+
+Thread-safety: ``emit`` takes a lock — the driver's scheduling loop is
+single-threaded, but checkpoint writes emit from the background writer
+thread (``hpo/driver.py``'s ``_write_ckpt``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import IO, Optional
+
+EVENTS_NAME = "events.jsonl"
+
+
+@dataclass
+class Event:
+    """One telemetry event. ``kind`` is the taxonomy key
+    (docs/OBSERVABILITY.md); identity tags are ``None`` when the
+    emitting seam doesn't know them."""
+
+    kind: str
+    ts: float
+    trial_id: Optional[int] = None
+    lane: Optional[int] = None
+    attempt: Optional[int] = None
+    step: Optional[int] = None
+    group_id: Optional[int] = None
+    data: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = {"kind": self.kind, "ts": self.ts}
+        for k in ("trial_id", "lane", "attempt", "step", "group_id"):
+            v = getattr(self, k)
+            if v is not None:
+                d[k] = v
+        if self.data:
+            d["data"] = self.data
+        return d
+
+
+class Bus:
+    """The process-local event bus (construct via :func:`configure`)."""
+
+    def __init__(self, path: Optional[str] = None, queue_max: int = 4096):
+        if queue_max < 1:
+            raise ValueError(f"queue_max must be >= 1, got {queue_max}")
+        self.path = path
+        self.queue_max = queue_max
+        self.dropped = 0
+        self.emitted = 0
+        self._recent: deque[Event] = deque()
+        self._lock = threading.Lock()
+        self._sink: Optional[IO[str]] = None
+        if path is not None:
+            # Truncate, don't append: one bus = one run's stream. A new
+            # configure() against the same directory (a re-run banking
+            # into artifacts/, a fresh chaos drill) must never mix the
+            # previous run's events into this run's exports. Appends
+            # WITHIN a run — including the chaos harness's driver
+            # restarts, which share one telemetry scope — go through
+            # this one handle.
+            self._sink = open(path, "w")
+
+    def emit(
+        self,
+        kind: str,
+        *,
+        trial_id: Optional[int] = None,
+        lane: Optional[int] = None,
+        attempt: Optional[int] = None,
+        step: Optional[int] = None,
+        group_id: Optional[int] = None,
+        **data,
+    ) -> Event:
+        """Record one event: append to the bounded ring (drop-oldest on
+        overflow) and to the JSONL sink (flushed, not fsync'd)."""
+        with self._lock:
+            # Timestamp INSIDE the lock: emitters race (the driver loop
+            # vs the background checkpoint writer), and stamping before
+            # acquisition could write the file in timestamp-inverted
+            # order — the monotonicity the chaos gate checks.
+            ev = Event(
+                kind=kind,
+                ts=time.time(),
+                trial_id=trial_id,
+                lane=lane,
+                attempt=attempt,
+                step=step,
+                group_id=group_id,
+                data=data,
+            )
+            self.emitted += 1
+            if len(self._recent) >= self.queue_max:
+                self._recent.popleft()
+                self.dropped += 1
+            self._recent.append(ev)
+            if self._sink is not None:
+                try:
+                    self._sink.write(
+                        json.dumps(ev.to_dict(), default=str) + "\n"
+                    )
+                    self._sink.flush()
+                except (OSError, ValueError):
+                    # Observability must never kill the sweep: a full
+                    # disk (or a stream closed under us — ValueError)
+                    # degrades to in-memory-only telemetry.
+                    try:
+                        self._sink.close()
+                    except (OSError, ValueError):
+                        pass
+                    self._sink = None
+        return ev
+
+    def recent(self) -> list[Event]:
+        """Snapshot of the bounded in-memory ring (oldest first)."""
+        with self._lock:
+            return list(self._recent)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sink is not None:
+                try:
+                    self._sink.close()
+                except OSError:
+                    pass
+                self._sink = None
+
+
+_bus: Optional[Bus] = None
+
+
+def get_bus() -> Optional[Bus]:
+    """The active bus, or ``None`` when telemetry is off. Hot-path
+    seams branch on this — the off cost is one global read."""
+    return _bus
+
+
+def configure(path: Optional[str] = None, *, queue_max: int = 4096) -> Bus:
+    """Install a fresh bus (closing any previous one)."""
+    global _bus
+    if _bus is not None:
+        _bus.close()
+    _bus = Bus(path=path, queue_max=queue_max)
+    return _bus
+
+
+def disable() -> None:
+    global _bus
+    if _bus is not None:
+        _bus.close()
+    _bus = None
+
+
+def read_events(path: str) -> list[dict]:
+    """All decodable events from a JSONL sink, in append order. A torn
+    final line (crash mid-append) is skipped, not fatal — the same
+    contract as :meth:`hpo.ledger.SweepLedger.load`."""
+    events: list[dict] = []
+    try:
+        f = open(path)
+    except OSError:
+        return events
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail
+            if isinstance(ev, dict):
+                events.append(ev)
+    return events

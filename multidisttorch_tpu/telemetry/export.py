@@ -1,0 +1,369 @@
+"""Exporters: Chrome/Perfetto trace, Prometheus text dump, run summary.
+
+The trace is built from the event stream (the JSONL sink or an
+in-memory event list), so a whole sweep renders as ONE timeline:
+
+- ``pid 1`` is the sweep; each trial gets its own track (``tid`` =
+  ``trial_id + 1``, named ``trial {id}``); driver-scoped events (sweep
+  start/end, bucket decisions) ride ``tid 0`` ("driver").
+- ``attempt_start``/``attempt_end`` pairs become complete ("X") spans
+  named ``attempt {n} -> {status}``; everything else is an instant
+  ("i") event carrying its payload in ``args`` — injected faults,
+  retries, lane retire/refill, checkpoint scan-backs, agreements all
+  appear as tagged, clickable marks on their trial's track.
+
+Timestamps are wall-clock seconds in the events; the trace uses
+microseconds relative to the first event (Chrome's ``ts`` unit), and
+the absolute epoch start rides in trace ``otherData``. Open with
+https://ui.perfetto.dev or ``chrome://tracing``.
+
+The Prometheus dump is the text exposition format (counters, gauges,
+histograms with ``_bucket``/``_sum``/``_count``, step series as
+derived gauges) — scrape-file shaped, parse-tested in
+tests/test_telemetry.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from multidisttorch_tpu.hpo.supervision import SETTLED_STATUSES
+from multidisttorch_tpu.telemetry import events as _events
+from multidisttorch_tpu.telemetry import metrics as _metrics
+
+TRACE_NAME = "trace.json"
+PROM_NAME = "metrics.prom"
+SUMMARY_NAME = "summary.json"
+
+_DRIVER_TID = 0
+
+
+def _tid(ev: dict) -> int:
+    t = ev.get("trial_id")
+    return _DRIVER_TID if t is None else int(t) + 1
+
+
+def build_trace(events: list[dict]) -> dict:
+    """Chrome ``trace_event`` JSON (dict form) from an event stream."""
+    if events:
+        t0 = min(float(ev.get("ts", 0.0)) for ev in events)
+    else:
+        t0 = 0.0
+
+    def us(ts: float) -> float:
+        return round((ts - t0) * 1e6, 1)
+
+    out: list[dict] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 1,
+            "args": {"name": "sweep"},
+        },
+        {
+            "name": "thread_name",
+            "ph": "M",
+            "pid": 1,
+            "tid": _DRIVER_TID,
+            "args": {"name": "driver"},
+        },
+    ]
+    named_tids = set()
+    # attempt spans: (trial_id, attempt) -> start event
+    open_attempts: dict[tuple, dict] = {}
+    for ev in sorted(events, key=lambda e: float(e.get("ts", 0.0))):
+        kind = ev.get("kind", "?")
+        ts = float(ev.get("ts", 0.0))
+        tid = _tid(ev)
+        if tid != _DRIVER_TID and tid not in named_tids:
+            named_tids.add(tid)
+            out.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": 1,
+                    "tid": tid,
+                    "args": {"name": f"trial {tid - 1}"},
+                }
+            )
+        args = {
+            k: v
+            for k, v in ev.items()
+            if k not in ("kind", "ts", "data")
+        }
+        args.update(ev.get("data") or {})
+        if kind == "attempt_start":
+            open_attempts[(ev.get("trial_id"), ev.get("attempt"))] = ev
+            continue
+        if kind == "attempt_end":
+            key = (ev.get("trial_id"), ev.get("attempt"))
+            start = open_attempts.pop(key, None)
+            status = (ev.get("data") or {}).get("status", "?")
+            begin = float(start["ts"]) if start else ts
+            out.append(
+                {
+                    "name": f"attempt {ev.get('attempt')} -> {status}",
+                    "cat": "attempt",
+                    "ph": "X",
+                    "pid": 1,
+                    "tid": tid,
+                    "ts": us(begin),
+                    "dur": max(0.0, us(ts) - us(begin)),
+                    "args": args,
+                }
+            )
+            continue
+        out.append(
+            {
+                "name": kind,
+                "cat": kind.split("_")[0],
+                "ph": "i",
+                "s": "t",
+                "pid": 1,
+                "tid": tid,
+                "ts": us(ts),
+                "args": args,
+            }
+        )
+    # A crash can leave attempts open (e.g. preemption): render what we
+    # know as zero-duration spans so the work still appears.
+    for (trial_id, attempt), start in open_attempts.items():
+        out.append(
+            {
+                "name": f"attempt {attempt} -> (unclosed)",
+                "cat": "attempt",
+                "ph": "X",
+                "pid": 1,
+                "tid": _tid(start),
+                "ts": us(float(start["ts"])),
+                "dur": 0.0,
+                "args": {},
+            }
+        )
+    out.sort(key=lambda e: (e.get("ts", -1.0), e.get("dur", 0.0)))
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {"epoch_start_s": t0, "events": len(events)},
+    }
+
+
+def _prom_name(name: str) -> str:
+    return "mdt_" + "".join(
+        c if c.isalnum() or c == "_" else "_" for c in name
+    )
+
+
+def _prom_labels(labels) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}"
+
+
+def prometheus_dump(
+    registry: Optional["_metrics.MetricsRegistry"] = None,
+) -> str:
+    """Prometheus text-exposition dump of the registry (or the active
+    one). Histograms emit cumulative ``_bucket`` series plus
+    ``_sum``/``_count``; step series emit derived rate gauges."""
+    registry = registry or _metrics.get_registry()
+    lines: list[str] = []
+    if registry is None:
+        return "# telemetry disabled\n"
+    typed: set[str] = set()
+
+    def head(name: str, mtype: str) -> None:
+        if name not in typed:
+            typed.add(name)
+            lines.append(f"# TYPE {name} {mtype}")
+
+    for kind, name, labels, obj in registry.series_items():
+        if kind == "counter":
+            n = _prom_name(name)
+            head(n, "counter")
+            lines.append(f"{n}{_prom_labels(labels)} {obj.value}")
+        elif kind == "gauge":
+            n = _prom_name(name)
+            head(n, "gauge")
+            lines.append(f"{n}{_prom_labels(labels)} {obj.value}")
+        elif kind == "histogram":
+            n = _prom_name(name)
+            head(n, "histogram")
+            cum = 0
+            for bound, c in zip(obj.bounds, obj.counts):
+                cum += c
+                lb = dict(labels)
+                lb["le"] = repr(float(bound))
+                lines.append(
+                    f"{n}_bucket{_prom_labels(tuple(sorted(lb.items())))} "
+                    f"{cum}"
+                )
+            lb = dict(labels)
+            lb["le"] = "+Inf"
+            lines.append(
+                f"{n}_bucket{_prom_labels(tuple(sorted(lb.items())))} "
+                f"{obj.count}"
+            )
+            lines.append(f"{n}_sum{_prom_labels(labels)} {obj.sum}")
+            lines.append(f"{n}_count{_prom_labels(labels)} {obj.count}")
+        elif kind == "step_series":
+            snap = obj.snapshot()
+            for field in (
+                "dispatches", "steps", "lane_steps", "total_s",
+                "steps_per_s", "per_lane_steps_per_s",
+            ):
+                if field in snap:
+                    n = _prom_name(f"step_{field}")
+                    head(n, "gauge")
+                    lines.append(
+                        f"{n}{_prom_labels(labels)} {snap[field]}"
+                    )
+    return "\n".join(lines) + "\n"
+
+
+class SweepFold:
+    """Incremental fold over an event stream: the ONE implementation of
+    the attempt/retry/goodput accounting, shared by :func:`run_summary`
+    (feeds a finished stream) and the live console
+    (``tools/sweep_top.py`` feeds decodable lines as they land). Keeping
+    a single fold is what guarantees the console, the summary JSON, and
+    the chaos bench read the same numbers off the same events."""
+
+    def __init__(self):
+        self.trials: dict[int, dict] = {}
+        self.by_kind: dict[str, int] = {}
+        self.events = 0
+        self.sweep: dict = {}
+        self.first_ts: Optional[float] = None
+        self.last_ts: Optional[float] = None
+        self.useful = 0
+        self.executed = 0
+        self.done = False
+
+    def _trial(self, tid: int) -> dict:
+        return self.trials.setdefault(
+            tid,
+            {
+                "status": "in_flight",
+                "attempts": 0,
+                "epoch": 0,
+                "step": 0,
+                "train_loss": None,
+                "test_loss": None,
+                "retries": 0,
+                "faults": 0,
+                "lane_events": 0,
+                "lane": None,
+                "first_ts": None,
+                "last_ts": None,
+            },
+        )
+
+    def feed(self, ev: dict) -> None:
+        self.events += 1
+        kind = ev.get("kind", "?")
+        self.by_kind[kind] = self.by_kind.get(kind, 0) + 1
+        ts = float(ev.get("ts", 0.0))
+        if self.first_ts is None:
+            self.first_ts = ts
+        self.last_ts = ts
+        if kind == "sweep_start":
+            self.sweep = ev.get("data") or {}
+        elif kind == "sweep_end":
+            self.done = True
+        tid = ev.get("trial_id")
+        if tid is None:
+            return
+        t = self._trial(int(tid))
+        t["last_ts"] = ts
+        if t["first_ts"] is None:
+            t["first_ts"] = ts
+        if ev.get("lane") is not None:
+            t["lane"] = ev["lane"]
+        data = ev.get("data") or {}
+        if kind == "attempt_start":
+            t["attempts"] = max(t["attempts"], int(ev.get("attempt") or 0))
+            t["status"] = "in_flight"
+        elif kind == "attempt_end":
+            status = data.get("status", "?")
+            t["status"] = status
+            if status == "retrying":
+                t["retries"] += 1
+            s = data.get("summary") or {}
+            done = int(s.get("steps", s.get("steps_at_failure", 0)) or 0)
+            resumed = int(s.get("resumed_from_step", 0) or 0)
+            self.executed += max(0, done - resumed)
+            if status in SETTLED_STATUSES:
+                self.useful += done
+        elif kind == "epoch":
+            t["epoch"] = int(data.get("epoch", t["epoch"]))
+            t["step"] = int(ev.get("step") or t["step"])
+            if data.get("avg_train_loss") is not None:
+                t["train_loss"] = data["avg_train_loss"]
+            if data.get("test_loss") is not None:
+                t["test_loss"] = data["test_loss"]
+        elif kind == "fault_injected":
+            t["faults"] += 1
+        elif kind.startswith("lane_"):
+            t["lane_events"] += 1
+
+    @property
+    def goodput(self) -> Optional[float]:
+        return self.useful / self.executed if self.executed else None
+
+
+def run_summary(
+    events: list[dict],
+    registry: Optional["_metrics.MetricsRegistry"] = None,
+) -> dict:
+    """Sweep-level rollup of an event stream (+ metrics snapshot when a
+    registry is live): per-trial attempt/status/retry accounting, fault
+    and lane-churn counts, and the goodput ratio (useful/executed
+    optimizer steps — the chaos bench's accounting, derived here from
+    ``attempt_end`` summaries instead of the ledger file)."""
+    registry = registry or _metrics.get_registry()
+    fold = SweepFold()
+    for ev in events:
+        fold.feed(ev)
+    out = {
+        "events": fold.events,
+        "by_kind": dict(sorted(fold.by_kind.items())),
+        "trials": {k: fold.trials[k] for k in sorted(fold.trials)},
+        "useful_steps": fold.useful,
+        "executed_steps": fold.executed,
+        "goodput": (
+            round(fold.goodput, 4) if fold.goodput is not None else None
+        ),
+    }
+    if registry is not None:
+        out["metrics"] = registry.snapshot()
+    return out
+
+
+def export_all(
+    out_dir: str,
+    events: Optional[list[dict]] = None,
+    registry: Optional["_metrics.MetricsRegistry"] = None,
+) -> dict:
+    """Write trace + Prometheus dump + run summary under ``out_dir``
+    (events default to ``out_dir``'s JSONL sink). Returns the paths."""
+    os.makedirs(out_dir, exist_ok=True)
+    if events is None:
+        events = _events.read_events(
+            os.path.join(out_dir, _events.EVENTS_NAME)
+        )
+    paths = {
+        "trace": os.path.join(out_dir, TRACE_NAME),
+        "prometheus": os.path.join(out_dir, PROM_NAME),
+        "summary": os.path.join(out_dir, SUMMARY_NAME),
+        "events": os.path.join(out_dir, _events.EVENTS_NAME),
+    }
+    with open(paths["trace"], "w") as f:
+        json.dump(build_trace(events), f)
+    with open(paths["prometheus"], "w") as f:
+        f.write(prometheus_dump(registry))
+    with open(paths["summary"], "w") as f:
+        json.dump(run_summary(events, registry), f, indent=2, default=str)
+    return paths

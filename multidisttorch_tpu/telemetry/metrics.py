@@ -1,0 +1,333 @@
+"""Metrics registry: counters, gauges, fixed-bucket histograms, and the
+sweep's step-time accounting.
+
+Absorbs and extends ``utils/profiling.StepTimer``: where the StepTimer
+collects one trial's raw mark-to-mark latencies, the registry holds the
+whole sweep's timing state keyed by series name + labels, understands
+**stacked buckets** (a mark that advances K lanes is one dispatch but K
+lane-steps — ``StepSeries`` keeps both books, so per-lane effective
+step rate falls out of the totals), separates **dispatch time** (what a
+mark measures in an async-dispatch loop) from **device-inclusive time**
+(sampled sparsely via ``jax.block_until_ready`` every
+``device_sample_every`` marks — cheap enough for the <= 2% overhead
+budget, honest enough to catch a device-bound step), and counts
+compiles (best-effort ``jax.monitoring`` listener).
+
+Histograms use FIXED log-spaced bucket bounds, so percentiles are
+bucket-upper-bound estimates computed in O(buckets) with zero per-
+observation allocation — the hot-path cost of ``observe`` is a bisect
+plus two float adds.
+
+Zero-cost-when-off: like the event bus, module state is ``None`` until
+:func:`configure`; hot paths guard with ``reg = get_registry(); if reg
+is not None: ...``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Optional
+
+# Log-spaced seconds: 10 us .. ~100 s, 4 buckets per decade.
+DEFAULT_TIME_BUCKETS = tuple(
+    round(10.0 ** (e / 4.0), 9) for e in range(-20, 9)
+)
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        self.value += n
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Histogram:
+    """Fixed-bucket histogram with percentile estimates.
+
+    ``bounds`` are the buckets' inclusive upper edges; observations
+    above the last bound land in the implicit +Inf bucket. Percentiles
+    return the upper bound of the bucket where the cumulative count
+    crosses the rank (+Inf bucket reports the max seen) — the standard
+    Prometheus-style estimate.
+    """
+
+    __slots__ = ("bounds", "counts", "count", "sum", "max")
+
+    def __init__(self, bounds=DEFAULT_TIME_BUCKETS):
+        self.bounds = tuple(float(b) for b in bounds)
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+        self.count += 1
+        self.sum += v
+        if v > self.max:
+            self.max = v
+
+    def percentile(self, p: float) -> float:
+        if self.count == 0:
+            return 0.0
+        rank = p / 100.0 * self.count
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= rank and c:
+                return self.bounds[i] if i < len(self.bounds) else self.max
+        return self.max
+
+    def stats(self) -> dict:
+        if self.count == 0:
+            return {"count": 0}
+        return {
+            "count": self.count,
+            "sum_s": self.sum,
+            "mean_s": self.sum / self.count,
+            "p50_s": self.percentile(50),
+            "p95_s": self.percentile(95),
+            "p99_s": self.percentile(99),
+            "max_s": self.max,
+        }
+
+
+class StepSeries:
+    """Step-time books for one trial or one stacked bucket.
+
+    ``mark(steps=s, lanes=k)`` closes the interval since the previous
+    mark: one *dispatch* advancing ``s`` optimizer steps on each of
+    ``k`` live lanes (classic trials are the k=1, s=1-or-fused case).
+    This is the stacked-mode fix for the old ``StepTimer`` semantics,
+    where a K-lane mark silently read as ONE trial's step time: the
+    bucket's dispatch latency and its lane-step count are kept apart,
+    and the per-lane effective step rate is derived from the totals
+    (``lane_steps / total_s``), never from misattributing the bucket's
+    latency to a single lane.
+    """
+
+    __slots__ = (
+        "dispatch", "device", "steps", "lane_steps", "dispatches",
+        "total_s", "_last", "_marks", "_sample_every",
+    )
+
+    def __init__(self, sample_every: int = 100):
+        self.dispatch = Histogram()
+        self.device = Histogram()
+        self.steps = 0
+        self.lane_steps = 0
+        self.dispatches = 0
+        self.total_s = 0.0
+        self._last: Optional[float] = None
+        self._marks = 0
+        self._sample_every = max(0, int(sample_every))
+
+    def mark(self, value=None, *, steps: int = 1, lanes: int = 1) -> None:
+        """Close one dispatch interval. ``value``, when given, enables
+        the sparse device-inclusive sample: every ``sample_every``-th
+        mark blocks on it (``jax.block_until_ready``) so the interval
+        includes device execution, not just host enqueue."""
+        now = time.perf_counter()
+        if self._last is None:
+            self._last = now
+            return
+        self._marks += 1
+        synced = False
+        if (
+            value is not None
+            and self._sample_every
+            and self._marks % self._sample_every == 0
+        ):
+            import jax
+
+            jax.block_until_ready(value)
+            synced = True
+            now = time.perf_counter()
+        dt = now - self._last
+        self._last = now
+        per_step = dt / steps if steps > 0 else dt
+        (self.device if synced else self.dispatch).observe(per_step)
+        self.dispatches += 1
+        self.steps += steps
+        self.lane_steps += steps * lanes
+        self.total_s += dt
+
+    def snapshot(self) -> dict:
+        out = {
+            "dispatches": self.dispatches,
+            "steps": self.steps,
+            "lane_steps": self.lane_steps,
+            "total_s": self.total_s,
+            "dispatch": self.dispatch.stats(),
+            "device_sampled": self.device.stats(),
+        }
+        if self.total_s > 0:
+            out["steps_per_s"] = self.steps / self.total_s
+            out["per_lane_steps_per_s"] = self.lane_steps / self.total_s
+        return out
+
+
+class MetricsRegistry:
+    """Name+labels keyed store of counters, gauges, histograms, and
+    step series. Label sets are frozen into sorted tuples so the same
+    logical series always lands in the same slot."""
+
+    def __init__(self, device_sample_every: int = 100):
+        self._lock = threading.Lock()
+        self.device_sample_every = device_sample_every
+        self._counters: dict = {}
+        self._gauges: dict = {}
+        self._hists: dict = {}
+        self._steps: dict = {}
+
+    @staticmethod
+    def _key(name: str, labels: dict) -> tuple:
+        return (name, tuple(sorted((k, str(v)) for k, v in labels.items())))
+
+    def counter(self, name: str, **labels) -> Counter:
+        k = self._key(name, labels)
+        with self._lock:
+            c = self._counters.get(k)
+            if c is None:
+                c = self._counters[k] = Counter()
+        return c
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        k = self._key(name, labels)
+        with self._lock:
+            g = self._gauges.get(k)
+            if g is None:
+                g = self._gauges[k] = Gauge()
+        return g
+
+    def histogram(
+        self, name: str, bounds=DEFAULT_TIME_BUCKETS, **labels
+    ) -> Histogram:
+        k = self._key(name, labels)
+        with self._lock:
+            h = self._hists.get(k)
+            if h is None:
+                h = self._hists[k] = Histogram(bounds)
+        return h
+
+    def step_series(self, key: str) -> StepSeries:
+        with self._lock:
+            s = self._steps.get(key)
+            if s is None:
+                s = self._steps[key] = StepSeries(
+                    sample_every=self.device_sample_every
+                )
+        return s
+
+    def step_mark(
+        self, key: str, value=None, *, steps: int = 1, lanes: int = 1
+    ) -> None:
+        """The driver's per-dispatch seam (see :class:`StepSeries`)."""
+        self.step_series(key).mark(value, steps=steps, lanes=lanes)
+
+    def snapshot(self) -> dict:
+        """Everything, JSON-ready — the run-summary's metrics block."""
+        def fmt(k: tuple) -> str:
+            name, labels = k
+            if not labels:
+                return name
+            return name + "{" + ",".join(
+                f'{lk}="{lv}"' for lk, lv in labels
+            ) + "}"
+
+        with self._lock:
+            return {
+                "counters": {
+                    fmt(k): c.value for k, c in self._counters.items()
+                },
+                "gauges": {fmt(k): g.value for k, g in self._gauges.items()},
+                "histograms": {
+                    fmt(k): h.stats() for k, h in self._hists.items()
+                },
+                "step_series": {
+                    k: s.snapshot() for k, s in self._steps.items()
+                },
+            }
+
+    def series_items(self):
+        """(kind, name, labels, obj) tuples for the Prometheus dump."""
+        with self._lock:
+            out = []
+            for (name, labels), c in self._counters.items():
+                out.append(("counter", name, labels, c))
+            for (name, labels), g in self._gauges.items():
+                out.append(("gauge", name, labels, g))
+            for (name, labels), h in self._hists.items():
+                out.append(("histogram", name, labels, h))
+            for key, s in self._steps.items():
+                out.append(("step_series", "step_time_s", (("key", key),), s))
+            return out
+
+
+_registry: Optional[MetricsRegistry] = None
+_compile_listener_installed = False
+
+
+def get_registry() -> Optional[MetricsRegistry]:
+    """The active registry, or ``None`` when telemetry is off."""
+    return _registry
+
+
+def configure(device_sample_every: int = 100) -> MetricsRegistry:
+    global _registry
+    _registry = MetricsRegistry(device_sample_every=device_sample_every)
+    return _registry
+
+
+def disable() -> None:
+    global _registry
+    _registry = None
+
+
+def install_compile_listener() -> bool:
+    """Best-effort compile accounting via ``jax.monitoring``: every
+    compile-flavored duration event increments ``compile_count`` and
+    accumulates ``compile_seconds``. Installed once per process (JAX
+    offers no unregister); the listener reads the CURRENT registry, so
+    after :func:`disable` it is a cheap no-op."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return True
+    try:
+        from jax import monitoring
+    except ImportError:
+        return False
+    hook = getattr(
+        monitoring, "register_event_duration_secs_listener", None
+    )
+    if hook is None:
+        return False
+
+    def on_event(name: str, secs: float, **kw) -> None:
+        reg = _registry
+        if reg is None or "compile" not in name:
+            return
+        reg.counter("compile_count").inc()
+        reg.counter("compile_seconds").inc(secs)
+
+    try:
+        hook(on_event)
+    except Exception:  # noqa: BLE001 — observability never raises
+        return False
+    _compile_listener_installed = True
+    return True

@@ -122,6 +122,11 @@ def save_state(
     latest is torn or corrupted. ``fsync=False`` opts out of the
     durability syncs (benchmarks on throwaway dirs).
     """
+    import time as _time
+
+    from multidisttorch_tpu.telemetry.events import get_bus
+
+    t0 = _time.perf_counter()
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     _require_fully_addressable(state, "save_state")
     host_state = jax.device_get(state)
@@ -138,6 +143,21 @@ def save_state(
 
     if keep_last > 1:
         _retain_version(path, meta, keep_last)
+    bus = get_bus()
+    if bus is not None:
+        # Emitted once the whole save — state, CRC sidecar, retention —
+        # has landed, so wall_s covers the full checkpoint cost and the
+        # trace never claims an integrity-checked save whose sidecar a
+        # crash then withheld. Runs on the background writer thread;
+        # the bus is locked.
+        bus.emit(
+            "ckpt_save",
+            step=meta.get("step"),
+            path=path,
+            nbytes=len(blob),
+            epoch=meta.get("completed_epochs"),
+            wall_s=round(_time.perf_counter() - t0, 6),
+        )
     return path
 
 
@@ -244,20 +264,49 @@ def restore_latest_valid(
     supervisor treats as "retry from scratch", never an error: recovery
     must degrade, not wedge.
     """
+    from multidisttorch_tpu.telemetry.events import get_bus
+
+    bus = get_bus()
     for cand in checkpoint_candidates(path):
-        ok, meta, _reason = verify_checkpoint(cand)
+        ok, meta, reason = verify_checkpoint(cand)
         if not ok:
+            if bus is not None:
+                # Scan-back transparency: every rejected candidate is a
+                # tagged event, so a chaos trace shows exactly which
+                # torn/corrupt files recovery had to skip.
+                bus.emit("ckpt_scan_reject", path=cand, reason=reason)
             continue
         meta = meta or {}
         if accept_meta is not None and not accept_meta(meta):
+            if bus is not None:
+                bus.emit(
+                    "ckpt_scan_reject", path=cand, reason="meta rejected"
+                )
             continue
         try:
             restored = restore_state(
                 template, cand, trial, shardings=shardings
             )
-        except Exception:  # noqa: BLE001 — scan on (CRC can't catch all)
+        except Exception as e:  # noqa: BLE001 — scan on (CRC can't catch all)
+            if bus is not None:
+                bus.emit(
+                    "ckpt_scan_reject",
+                    path=cand,
+                    reason=f"restore failed: {type(e).__name__}",
+                )
             continue
+        if bus is not None:
+            # restore_state above already emitted the plain
+            # "ckpt_restore"; this one tags the scan-back outcome.
+            bus.emit(
+                "ckpt_scan_restore",
+                step=meta.get("step"),
+                path=cand,
+                epoch=meta.get("completed_epochs"),
+            )
         return restored, meta, cand
+    if bus is not None:
+        bus.emit("ckpt_scan_none", path=path)
     return None
 
 
@@ -317,4 +366,13 @@ def restore_state(
         restored = serialization.from_bytes(jax.device_get(template), f.read())
     if trial is not None:
         restored = trial.device_put(restored, shardings)
+    from multidisttorch_tpu.telemetry.events import get_bus
+
+    bus = get_bus()
+    if bus is not None:
+        bus.emit(
+            "ckpt_restore",
+            group_id=getattr(trial, "group_id", None),
+            path=path,
+        )
     return restored

@@ -6,10 +6,26 @@ a job with N subgroups emits exactly N lines per logging call site. The
 TPU-native mapping: "group-rank 0" becomes "the process owning the
 group's first device" (in single-controller mode that is always this
 process, so every trial logs exactly once, as before).
+
+Emission routes through the stdlib :mod:`logging` module (logger
+``multidisttorch_tpu``) with the prefix format preserved bit-for-bit:
+the handler renders the bare message, and the message already carries
+the reference's ``[process:group_rank]`` prefix. This gives sweeps a
+standard volume knob without losing the reference's per-trial
+contract — the driver tags per-STEP chatter (the ``Train Epoch:``
+lines) at ``DEBUG`` and per-TRIAL lines at ``INFO``, and the logger's
+default level is ``DEBUG`` so default output is unchanged; to silence
+step chatter::
+
+    logging.getLogger("multidisttorch_tpu").setLevel(logging.INFO)
+
+Callers that pass an explicit ``file=`` keep a direct write to that
+stream (the parity-test path), still subject to the level filter.
 """
 
 from __future__ import annotations
 
+import logging
 import sys
 from typing import Optional
 
@@ -17,12 +33,50 @@ import jax
 
 from multidisttorch_tpu.parallel.mesh import TrialMesh
 
+LOGGER_NAME = "multidisttorch_tpu"
+
+
+class _StdoutHandler(logging.Handler):
+    """Writes bare messages to the CURRENT ``sys.stdout`` (looked up at
+    emit time, so pytest capture and stream redirection keep working —
+    a StreamHandler bound at import time would pin the original fd)."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            print(self.format(record), file=sys.stdout)
+        except Exception:  # noqa: BLE001 — logging must not raise
+            self.handleError(record)
+
+
+def _get_logger() -> logging.Logger:
+    logger = logging.getLogger(LOGGER_NAME)
+    if not any(isinstance(h, _StdoutHandler) for h in logger.handlers):
+        handler = _StdoutHandler()
+        handler.setFormatter(logging.Formatter("%(message)s"))
+        logger.addHandler(handler)
+        logger.propagate = False
+        if logger.level == logging.NOTSET:
+            # DEBUG by default: every reference-parity line (including
+            # the DEBUG-tagged per-step chatter) prints unless a sweep
+            # explicitly raises the level.
+            logger.setLevel(logging.DEBUG)
+    return logger
+
+
+def log0_enabled(level: int = logging.INFO) -> bool:
+    """Whether a ``log0(..., level=level)`` call would emit (process
+    gating aside). Hot loops check this BEFORE paying for the log
+    line's inputs — the driver skips the per-step device sync entirely
+    when step chatter is silenced."""
+    return _get_logger().isEnabledFor(level)
+
 
 def log0(
     *args,
     trial: Optional[TrialMesh] = None,
     sep: str = " ",
     file=None,
+    level: int = logging.INFO,
 ) -> bool:
     """Print once per group; returns whether this process printed.
 
@@ -32,16 +86,26 @@ def log0(
     exactly as the reference prefixes ``[world_rank:group_rank]``
     (``utils.py:173-174``) — the printer's group rank is by construction
     0, so the visible prefix matches the reference's output shape.
+
+    ``level`` filters through the stdlib logger (see module docstring);
+    a suppressed level returns False without touching ``args``' values.
     """
-    out = sys.stdout if file is None else file
+    logger = _get_logger()
+    if not logger.isEnabledFor(level):
+        return False
     pid = jax.process_index()
     if trial is None:
         if pid != 0:
             return False
-        print(f"[{pid}:0]", sep.join(map(str, args)), file=out)
-        return True
-    owner = trial.devices[0].process_index
-    if pid != owner:
-        return False
-    print(f"[{pid}:0]", sep.join(map(str, args)), file=out)
+    else:
+        owner = trial.devices[0].process_index
+        if pid != owner:
+            return False
+    msg = f"[{pid}:0] " + sep.join(map(str, args))
+    if file is not None:
+        # Explicit stream: write directly (bit-for-bit parity path for
+        # callers that capture output), bypassing the shared handler.
+        print(msg, file=file)
+    else:
+        logger.log(level, msg)
     return True

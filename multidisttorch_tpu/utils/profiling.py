@@ -45,28 +45,46 @@ class StepTimer:
     Note: in an async-dispatch loop, per-step host time measures
     *dispatch* cost; call ``mark(sync=True)`` (blocks on ``value``) at
     sparse intervals to sample true device-inclusive step time.
+
+    **Stacked-mode semantics**: a mark that closes a K-lane stacked
+    dispatch (docs/STACKING.md) is ONE dispatch but K lane-steps of
+    training progress — pass ``lanes=K`` so the timing is attributed to
+    the *bucket* and :meth:`stats` can report the per-lane effective
+    step rate (``lane_steps / total_s``) instead of silently reading
+    the bucket's latency as a single trial's step time. The sweep-wide
+    generalization of this collector (per-key series, dispatch vs
+    device-sampled books, fixed-bucket percentiles) lives in
+    ``telemetry.metrics.StepSeries``, which absorbs these semantics.
     """
 
     times: list = field(default_factory=list)
+    lanes: list = field(default_factory=list)
     _last: float = field(default_factory=time.perf_counter)
 
-    def mark(self, value=None, sync: bool = False):
+    def mark(self, value=None, sync: bool = False, lanes: int = 1):
         if sync and value is not None:
             import jax
 
             jax.block_until_ready(value)
         now = time.perf_counter()
         self.times.append(now - self._last)
+        self.lanes.append(lanes)
         self._last = now
 
     def stats(self) -> dict:
         if not self.times:
             return {}
         arr = np.asarray(self.times)
-        return {
+        out = {
             "steps": len(arr),
             "mean_s": float(arr.mean()),
             "p50_s": float(np.percentile(arr, 50)),
             "p95_s": float(np.percentile(arr, 95)),
             "total_s": float(arr.sum()),
         }
+        lane_steps = int(sum(self.lanes))
+        if lane_steps != len(arr):  # at least one stacked mark
+            out["lane_steps"] = lane_steps
+            if out["total_s"] > 0:
+                out["per_lane_steps_per_s"] = lane_steps / out["total_s"]
+        return out

@@ -1,9 +1,18 @@
-"""log0 tests — parity with /root/reference/utils.py:165-174 (print0)."""
+"""log0 tests — parity with /root/reference/utils.py:165-174 (print0),
+plus the stdlib-logging level routing (sweeps can silence per-step
+chatter without losing the reference's per-trial contract)."""
 
 import io
+import logging
+
+import pytest
 
 from multidisttorch_tpu.parallel.mesh import setup_groups
-from multidisttorch_tpu.utils.logging import log0
+from multidisttorch_tpu.utils.logging import (
+    LOGGER_NAME,
+    log0,
+    log0_enabled,
+)
 
 
 def test_one_line_per_group():
@@ -28,3 +37,68 @@ def test_sep_honored():
     buf = io.StringIO()
     log0("a", "b", sep="|", file=buf)
     assert buf.getvalue() == "[0:0] a|b\n"
+
+
+@pytest.fixture
+def _restore_level():
+    logger = logging.getLogger(LOGGER_NAME)
+    # Touch log0 once so the handler/level initialization has happened.
+    log0("init", file=io.StringIO())
+    before = logger.level
+    yield logger
+    logger.setLevel(before)
+
+
+def test_default_level_prints_debug_chatter(_restore_level):
+    # The logger defaults to DEBUG so reference-parity output (which
+    # includes the DEBUG-tagged per-step lines) is unchanged by default.
+    buf = io.StringIO()
+    assert log0("step line", file=buf, level=logging.DEBUG) is True
+    assert buf.getvalue() == "[0:0] step line\n"
+    assert log0_enabled(logging.DEBUG)
+
+
+def test_raised_level_silences_step_chatter(_restore_level):
+    logger = _restore_level
+    logger.setLevel(logging.INFO)
+    buf = io.StringIO()
+    # Per-step chatter (DEBUG) is dropped without touching the stream...
+    assert log0("step line", file=buf, level=logging.DEBUG) is False
+    assert buf.getvalue() == ""
+    assert not log0_enabled(logging.DEBUG)
+    # ...while the per-trial contract (INFO lines) is preserved
+    # bit-for-bit.
+    assert log0("====> Epoch: 1", file=buf) is True
+    assert buf.getvalue() == "[0:0] ====> Epoch: 1\n"
+
+
+def test_stdout_routing_through_stdlib_handler(_restore_level, capsys):
+    # Without file=, emission goes through the stdlib logger's handler
+    # to the CURRENT sys.stdout — prefix preserved bit-for-bit.
+    assert log0("hello", "world") is True
+    assert capsys.readouterr().out == "[0:0] hello world\n"
+
+
+def test_driver_step_chatter_gated_by_level(_restore_level, tmp_path):
+    # End-to-end: a sweep at INFO level emits the per-trial lines but
+    # not one "Train Epoch:" step line — and skips the per-step device
+    # sync entirely (host_syncs drops to the 2-per-epoch floor).
+    from multidisttorch_tpu.data.datasets import synthetic_mnist
+    from multidisttorch_tpu.hpo.driver import TrialConfig, run_hpo
+
+    logger = _restore_level
+    cfg = [
+        TrialConfig(trial_id=0, epochs=1, batch_size=16, hidden_dim=16,
+                    latent_dim=4, log_interval=1),
+        TrialConfig(trial_id=1, epochs=1, batch_size=16, hidden_dim=16,
+                    latent_dim=4, log_interval=1),
+    ]
+    data = synthetic_mnist(48, seed=0)
+    logger.setLevel(logging.INFO)
+    results = run_hpo(
+        cfg, data, data, num_groups=2, out_dir=str(tmp_path),
+        save_images=False,
+    )
+    # log_interval=1 would have logged (and synced) every one of the 3
+    # steps per trial; at INFO those syncs are skipped wholesale.
+    assert all(r.host_syncs == 2 for r in results)

@@ -25,6 +25,9 @@ import os
 import sys
 import tempfile
 
+# Allow running straight from a checkout (tools/ is not a package).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def main() -> int:
     parser = argparse.ArgumentParser(
@@ -58,6 +61,13 @@ def main() -> int:
         "format; trial_ids must be 0..trials-1) instead of the "
         "standard schedule. Report-only: the goodput >= 0.8 acceptance "
         "gate applies to the standard schedule only",
+    )
+    parser.add_argument(
+        "--telemetry-dir", default=None,
+        help="write the chaos run's telemetry (events.jsonl, Perfetto "
+        "trace.json, metrics.prom, summary.json) here instead of "
+        "{work_dir}/telemetry — what CI uploads as artifacts; open the "
+        "trace at https://ui.perfetto.dev (docs/OBSERVABILITY.md)",
     )
     args = parser.parse_args()
 
@@ -99,14 +109,20 @@ def main() -> int:
         include_preempt=not args.no_preempt,
         stacked=args.stacked,
         plan=plan,
+        telemetry_dir=args.telemetry_dir,
     )
 
+    tel = report.get("telemetry") or {}
     ok = (
         report["all_infra_faults_recovered"]
         and report["final_metrics_bit_identical"]
         # the goodput bar is the STANDARD schedule's acceptance; a
         # custom plan is report-only there (its author owns the bar)
         and (plan is not None or report["goodput"] >= 0.8)
+        # the observability acceptance: every fired fault appears as a
+        # tagged event in a monotonic, Perfetto-loadable trace
+        and tel.get("all_faults_traced", False)
+        and tel.get("trace_monotonic", False)
     )
     headline = {
         "metric": "chaos_goodput_useful_over_executed_steps",
@@ -116,6 +132,8 @@ def main() -> int:
         "all_infra_faults_recovered": report["all_infra_faults_recovered"],
         "final_metrics_bit_identical": report["final_metrics_bit_identical"],
         "restarts_after_preemption": report["restarts_after_preemption"],
+        "telemetry_trace": tel.get("trace"),
+        "all_faults_traced": tel.get("all_faults_traced"),
         "detail": report,
     }
     print(json.dumps(headline))

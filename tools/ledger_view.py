@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Human-readable dump of a sweep ledger (``hpo/ledger.py``).
+
+    python tools/ledger_view.py <out-dir-or-sweep_ledger.jsonl>
+
+Shows, per config hash: the trial id, the full attempt history
+(attempt number, status, error, executed steps), and whether the
+config is SETTLED (completed/diverged under that exact config — a
+restarted ``run_hpo(resume=True)`` will skip it) or IN-FLIGHT (an
+``attempt_start`` with no matching end: the driver died mid-attempt).
+
+Formatting is shared with ``tools/sweep_top.py`` via
+``telemetry.console`` so the two tools read as one family.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from multidisttorch_tpu.telemetry.console import (  # noqa: E402
+    fmt_table,
+    fmt_ts,
+    status_glyph,
+)
+
+LEDGER_NAME = "sweep_ledger.jsonl"
+
+
+def resolve_ledger_path(path: str) -> str:
+    if os.path.isdir(path):
+        return os.path.join(path, LEDGER_NAME)
+    return path
+
+
+def load_ledger(path: str) -> list[dict]:
+    # Torn-tail-tolerant JSONL read — same contract as SweepLedger.load
+    # but importable without jax (the ledger module pulls no heavy deps
+    # either; reuse it).
+    from multidisttorch_tpu.hpo.ledger import SweepLedger
+
+    led = SweepLedger(os.path.dirname(path) or ".", enabled=True)
+    led.path = path
+    return led.load()
+
+
+def fold(events: list[dict]) -> dict[str, dict]:
+    """config_hash -> {trial_id, attempts: [...], settled, in_flight}."""
+    out: dict[str, dict] = {}
+    for ev in events:
+        h = ev.get("config_hash")
+        if not h:
+            continue
+        rec = out.setdefault(
+            h, {"trial_id": ev.get("trial_id"), "attempts": {}}
+        )
+        a = int(ev.get("attempt", 0))
+        att = rec["attempts"].setdefault(
+            a, {"attempt": a, "status": "in_flight", "error": "",
+                "steps": None, "ts": ev.get("ts")}
+        )
+        if ev.get("event") == "attempt_end":
+            att["status"] = ev.get("status", "?")
+            att["error"] = ev.get("error", "") or ""
+            att["ts"] = ev.get("ts")
+            s = ev.get("summary") or {}
+            steps = s.get("steps", s.get("steps_at_failure"))
+            if steps is not None:
+                att["steps"] = int(steps)
+    for rec in out.values():
+        atts = [rec["attempts"][k] for k in sorted(rec["attempts"])]
+        rec["attempts"] = atts
+        last = atts[-1] if atts else None
+        rec["settled"] = bool(
+            last and last["status"] in ("completed", "diverged")
+        )
+        rec["in_flight"] = bool(last and last["status"] == "in_flight")
+    return out
+
+
+def render(folded: dict[str, dict], path: str) -> str:
+    lines = [f"sweep ledger  {path}", ""]
+    settled = sum(1 for r in folded.values() if r["settled"])
+    in_flight = sum(1 for r in folded.values() if r["in_flight"])
+    lines.append(
+        f"configs {len(folded)}  settled {settled}  in-flight {in_flight}"
+        f"  other {len(folded) - settled - in_flight}"
+    )
+    lines.append("")
+    rows = []
+    for h, rec in sorted(
+        folded.items(), key=lambda kv: (kv[1].get("trial_id") or 0, kv[0])
+    ):
+        history = " -> ".join(
+            f"#{a['attempt']}:{status_glyph(a['status'])}"
+            for a in rec["attempts"]
+        )
+        last = rec["attempts"][-1] if rec["attempts"] else {}
+        rows.append(
+            [
+                rec.get("trial_id", "?"),
+                h[:10],
+                "SETTLED" if rec["settled"]
+                else ("IN-FLIGHT" if rec["in_flight"] else "open"),
+                len(rec["attempts"]),
+                history,
+                last.get("steps") if last.get("steps") is not None else "-",
+                fmt_ts(last.get("ts")),
+                (last.get("error") or "")[:48],
+            ]
+        )
+    lines.append(
+        fmt_table(
+            rows,
+            ["trial", "config", "state", "att", "history", "steps",
+             "last", "error"],
+        )
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="human-readable sweep-ledger dump "
+        "(attempt history per config hash, settled vs in-flight)"
+    )
+    parser.add_argument(
+        "path",
+        help="sweep out-dir (containing sweep_ledger.jsonl) or the file",
+    )
+    args = parser.parse_args(argv)
+    path = resolve_ledger_path(args.path)
+    if not os.path.exists(path):
+        print(f"no ledger at {path}", file=sys.stderr)
+        return 1
+    events = load_ledger(path)
+    if not events:
+        print(f"ledger at {path} holds no decodable events")
+        return 0
+    print(render(fold(events), path))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

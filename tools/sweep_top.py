@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Live sweep console: tail the telemetry event JSONL and render
+per-trial status, step rates, retries, and sweep goodput.
+
+    python tools/sweep_top.py <telemetry-dir-or-events.jsonl> [--follow]
+
+Works on a LIVE run (``--follow`` re-reads new lines each interval and
+redraws — the sink is flushed per event, so a running sweep streams)
+or on a finished one (one-shot render). It only reads the JSONL — it
+never initializes a jax backend or touches the accelerator, so it can
+run next to a live sweep.
+
+Enable telemetry on the sweep side with ``MDT_TELEMETRY=1
+MDT_TELEMETRY_DIR=<dir>`` or ``telemetry.telemetry_run(<dir>)`` — see
+docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# Allow running straight from a checkout (tools/ is not a package).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from multidisttorch_tpu.telemetry.console import (  # noqa: E402
+    clear_screen,
+    fmt_duration,
+    fmt_rate,
+    fmt_table,
+    fmt_ts,
+    status_glyph,
+)
+from multidisttorch_tpu.telemetry.events import EVENTS_NAME  # noqa: E402
+from multidisttorch_tpu.telemetry.export import SweepFold  # noqa: E402
+
+
+def resolve_events_path(path: str) -> str:
+    if os.path.isdir(path):
+        return os.path.join(path, EVENTS_NAME)
+    return path
+
+
+def render(state: SweepFold, path: str) -> str:
+    lines = []
+    span = (
+        (state.last_ts - state.first_ts)
+        if state.first_ts is not None
+        else None
+    )
+    head = [
+        f"sweep_top  {path}",
+        f"events {state.events}"
+        + (f"  span {fmt_duration(span)}" if span is not None else "")
+        + (f"  last {fmt_ts(state.last_ts)}" if state.last_ts else ""),
+    ]
+    if state.sweep:
+        head.append(
+            "configs {configs}  groups {groups}  stacked {stacked}".format(
+                configs=state.sweep.get("configs", "?"),
+                groups=state.sweep.get("groups", "?"),
+                stacked=state.sweep.get("stacked", False),
+            )
+        )
+    goodput = state.goodput
+    head.append(
+        "goodput "
+        + (f"{goodput:.3f}" if goodput is not None else "-")
+        + f"  (useful {state.useful} / executed {state.executed} steps)"
+        + ("  [sweep finished]" if state.done else "")
+    )
+    lines.extend(head)
+    lines.append("")
+    rows = []
+    for tid in sorted(state.trials):
+        t = state.trials[tid]
+        wall = (
+            t["last_ts"] - t["first_ts"]
+            if t["first_ts"] is not None and t["last_ts"] is not None
+            else None
+        )
+        rate = t["step"] / wall if wall and t["step"] else None
+        rows.append(
+            [
+                tid,
+                status_glyph(t["status"]),
+                t["attempts"] or "-",
+                t["epoch"] or "-",
+                t["step"] or "-",
+                fmt_rate(rate, "/s") if rate else "-",
+                f"{t['train_loss']:.4f}" if t["train_loss"] is not None
+                else "-",
+                f"{t['test_loss']:.4f}" if t["test_loss"] is not None
+                else "-",
+                t["retries"],
+                t["faults"],
+                t["lane"] if t["lane"] is not None else "-",
+                fmt_duration(wall),
+            ]
+        )
+    lines.append(
+        fmt_table(
+            rows,
+            ["trial", "status", "att", "epoch", "steps", "step rate",
+             "train loss", "test loss", "retries", "faults", "lane",
+             "wall"],
+        )
+    )
+    return "\n".join(lines)
+
+
+def follow_lines(path: str, state: SweepFold, offset: int) -> int:
+    """Feed decodable complete lines past ``offset``; returns the new
+    offset. A torn tail (no trailing newline yet) is left for the next
+    poll — the live analog of read_events' torn-tail tolerance."""
+    try:
+        with open(path) as f:
+            f.seek(offset)
+            chunk = f.read()
+    except OSError:
+        return offset
+    if not chunk:
+        return offset
+    consumed = 0
+    for line in chunk.splitlines(keepends=True):
+        if not line.endswith("\n"):
+            break  # torn tail: wait for the writer to finish the line
+        consumed += len(line)
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            ev = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(ev, dict):
+            state.feed(ev)
+    return offset + consumed
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="live console over a sweep's telemetry event JSONL"
+    )
+    parser.add_argument(
+        "path",
+        help="telemetry dir (containing events.jsonl) or the JSONL file",
+    )
+    parser.add_argument(
+        "-f", "--follow", action="store_true",
+        help="keep tailing and redraw every --interval seconds",
+    )
+    parser.add_argument("--interval", type=float, default=1.0)
+    parser.add_argument(
+        "--max-refreshes", type=int, default=0,
+        help="stop after N redraws (0 = until interrupted/sweep end; "
+        "mostly for tests)",
+    )
+    args = parser.parse_args(argv)
+
+    path = resolve_events_path(args.path)
+    if not os.path.exists(path) and not args.follow:
+        print(f"no event file at {path}", file=sys.stderr)
+        return 1
+    state = SweepFold()
+    offset = follow_lines(path, state, 0)
+    if not args.follow:
+        print(render(state, path))
+        return 0
+    refreshes = 0
+    try:
+        while True:
+            print(clear_screen() + render(state, path), flush=True)
+            refreshes += 1
+            if state.done:
+                break
+            if args.max_refreshes and refreshes >= args.max_refreshes:
+                break
+            time.sleep(args.interval)
+            offset = follow_lines(path, state, offset)
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
