@@ -335,28 +335,56 @@ def _train_flops_per_sample() -> float:
     return 3.0 * fwd
 
 
-# Peak dense bf16 FLOP/s per chip by device generation (public numbers).
-_PEAK_FLOPS = {
-    "v4": 275e12,
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v5": 459e12,
-    "v6 lite": 918e12,
-    "v6e": 918e12,
-}
-
-
 def _peak_flops_per_chip(device_kind: str) -> float | None:
-    kind = (device_kind or "").lower()
-    for key in sorted(_PEAK_FLOPS, key=len, reverse=True):
-        if key in kind:
-            return _PEAK_FLOPS[key]
-    # Only when the device kind itself is unrecognized, fall back to the
-    # environment's generation hint (a stale hint must not override a
-    # real detection).
-    hint = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    return _PEAK_FLOPS.get(hint)
+    # The peak table moved to telemetry/device.py (the device books'
+    # MFU needs it at sweep time); bench delegates so the two MFU
+    # computations can never disagree on what "peak" means.
+    from multidisttorch_tpu.telemetry.device import peak_flops_per_chip
+
+    return peak_flops_per_chip(device_kind)
+
+
+def _flops_agreement(
+    analytic: float, fn, args, per_step_divisor: float, devices: int = 1
+) -> dict:
+    """Cross-check an analytic FLOPs estimate against XLA's own
+    ``cost_analysis`` of the compiled program (telemetry/device.py).
+
+    ``per_step_divisor`` converts the compiled dispatch's total FLOPs
+    to the analytic estimate's unit (per sample / per token);
+    ``devices`` is the submesh size the program is partitioned over —
+    ``cost_analysis`` describes the PER-DEVICE module (measured:
+    1/n of global on an n-device data-sharded program), while the
+    divisor counts global samples/tokens, so the per-device figure is
+    scaled back to global first. The banked MFU numbers stop being
+    trust-me arithmetic: the artifact records both figures and flags
+    >10% disagreement.
+
+    Known caveat the flag is EXPECTED to trip on: XLA:CPU rewrites
+    large dots to library custom calls (oneDNN/Eigen) whose FLOPs the
+    analysis does not count, so the CPU fallback undercounts matmul-
+    heavy programs. The check's authority is the TPU path, where dots
+    stay HLO dots; a CPU-artifact flag documents that undercount
+    rather than an arithmetic error."""
+    from multidisttorch_tpu.telemetry.device import compiled_cost_analysis
+
+    ca = compiled_cost_analysis(fn, args)
+    if ca["flops"] is None:
+        return {"analytic": analytic, "cost_analysis": None,
+                "reason": ca["reason"]}
+    measured = ca["flops"] * max(1, devices) / per_step_divisor
+    ratio = measured / analytic if analytic else None
+    return {
+        "analytic": analytic,
+        "cost_analysis": round(measured, 1),
+        "ratio": round(ratio, 4) if ratio is not None else None,
+        # XLA counts every op post-optimization; the analytic figure is
+        # matmuls-only —>10% disagreement means the banked MFU's
+        # numerator needs a second look, in either direction.
+        "disagrees_over_10pct": (
+            bool(abs(ratio - 1.0) > 0.10) if ratio is not None else None
+        ),
+    }
 
 
 def _flagship_setup(num_groups: int = 1):
@@ -375,7 +403,9 @@ def _flagship_setup(num_groups: int = 1):
     return groups, model, tx
 
 
-def _timed_chunks(trial, model, tx, **step_kwargs) -> tuple[float, list]:
+def _timed_chunks(
+    trial, model, tx, agreement: bool = True, **step_kwargs
+) -> tuple[float, list, dict]:
     """The one measurement protocol: scan-fused dispatch (a
     backend-sized chunk of optimizer updates per host round-trip —
     ``_chunk_steps()`` — the TPU-idiomatic shape of the reference's
@@ -425,7 +455,20 @@ def _timed_chunks(trial, model, tx, **step_kwargs) -> tuple[float, list]:
             jax.block_until_ready(state.params)
             dt = time.perf_counter() - t0
         rates.append(MEASURE_CHUNKS * chunk * BATCH / dt)
-    return float(np.median(rates)), rates
+    # MFU cross-check (unit: FLOPs per sample): XLA's cost analysis of
+    # the exact program timed above vs the analytic matmul count.
+    # agreement=False skips it — the AOT lower+compile is a real extra
+    # compile, wasted on callers that discard the dict (the fused-loss
+    # comparison times two program variants and keeps only the rates).
+    agree = (
+        _flops_agreement(
+            _train_flops_per_sample(), multi, (state, batches, key),
+            chunk * BATCH, devices=trial.size,
+        )
+        if agreement
+        else {}
+    )
+    return float(np.median(rates)), rates, agree
 
 
 def bench_ours() -> dict:
@@ -435,7 +478,7 @@ def bench_ours() -> dict:
     variable tunnel."""
     ndev = len(jax.devices())
     (trial,), model, tx = _flagship_setup(1)
-    med, rates = _timed_chunks(trial, model, tx)
+    med, rates, flops_agreement = _timed_chunks(trial, model, tx)
     per_chip = [r / ndev for r in rates]
     return {
         "samples_per_sec_per_chip": round(med / ndev, 1),
@@ -443,6 +486,9 @@ def bench_ours() -> dict:
         "p10": round(float(np.percentile(per_chip, 10)), 1),
         "p90": round(float(np.percentile(per_chip, 90)), 1),
         "passes": len(per_chip),
+        # Analytic-vs-XLA FLOPs/sample for the timed program — the
+        # flagship MFU's numerator, cross-checked (>10% flags).
+        "flops_agreement": flops_agreement,
         # Measurement shape provenance: the chunk became
         # backend-dependent in r5, so cross-round artifact comparisons
         # need the value recorded next to the number it produced.
@@ -463,7 +509,9 @@ def bench_fused_loss_comparison() -> dict:
     (trial,), model, tx = _flagship_setup(1)
     out = {}
     for label, fused in (("xla_loss", False), ("pallas_fused_loss", True)):
-        med, rates = _timed_chunks(trial, model, tx, use_fused_loss=fused)
+        med, rates, _agree = _timed_chunks(
+            trial, model, tx, agreement=False, use_fused_loss=fused
+        )
         out[label + "_samples_per_sec"] = round(med, 1)
         out[label + "_pass_rates"] = [round(r, 1) for r in rates]
     out["winner"] = (
@@ -667,29 +715,39 @@ def bench_telemetry_overhead() -> dict:
     state, _ = step(state, hypers, batch, base_rngs, lane_steps[0])
     jax.block_until_ready(state.params)
 
-    def timed_pass(reg) -> float:
+    def timed_pass(reg, mon) -> float:
         nonlocal state
         t0 = time.perf_counter()
         for i in range(STACKED_MEASURE_STEPS):
             state, m = step(state, hypers, batch, base_rngs, lane_steps[i])
             if reg is not None:
-                reg.step_mark("bucket-g0", m["loss_sum"], lanes=k)
+                # EXACTLY the driver's per-dispatch seam, device books
+                # included: the mark plus the straggler detector's
+                # observe (hpo/driver.py's _device_seam) — the <=2%
+                # budget now covers the anomaly layer too.
+                dt = reg.step_mark("bucket-g0", m["loss_sum"], lanes=k)
+                if mon is not None and dt is not None:
+                    mon.observe_step("bucket-g0", dt)
         jax.block_until_ready(state.params)
         return (time.perf_counter() - t0) / STACKED_MEASURE_STEPS
 
     off_times, on_times = [], []
     with telemetry.telemetry_run(None):  # in-memory registry, no sink
         reg = telemetry.get_registry()
+        mon = telemetry.get_monitor()
         for p in range(TELEMETRY_AB_PASSES):
             if p % 2 == 0:
-                off_times.append(timed_pass(None))
+                off_times.append(timed_pass(None, None))
             else:
-                on_times.append(timed_pass(reg))
-        # Per-mark microbench: the emit seam's cost in isolation.
+                on_times.append(timed_pass(reg, mon))
+        # Per-mark microbench: the emit seam's cost in isolation
+        # (mark + anomaly observe, the full per-dispatch hot path).
         n = 10000
         t0 = time.perf_counter()
         for _ in range(n):
-            reg.step_mark("microbench", None, lanes=k)
+            dt = reg.step_mark("microbench", None, lanes=k)
+            if mon is not None and dt is not None:
+                mon.observe_step("microbench", dt)
         per_mark_us = (time.perf_counter() - t0) / n * 1e6
     off_s, on_s = min(off_times), min(on_times)
     overhead = on_s / off_s - 1.0
@@ -779,7 +837,7 @@ def bench_lm() -> dict:
         lm_chunk_sharding(trial),
     )
 
-    def timed(attention) -> tuple[float, list, float]:
+    def timed(attention) -> tuple[float, list, float, dict]:
         model = TransformerLM(
             vocab_size=LM_VOCAB, d_model=LM_DMODEL, num_heads=LM_HEADS,
             num_layers=LM_LAYERS, max_len=LM_SEQ, dtype=dtype,
@@ -799,7 +857,16 @@ def bench_lm() -> dict:
             rates.append(
                 LM_STEPS * LM_BATCH * LM_SEQ / (time.perf_counter() - t0)
             )
-        return float(np.median(rates)), rates, float(metrics["loss"][-1])
+        # MFU cross-check: XLA's own cost analysis of the program just
+        # timed, vs the analytic per-token estimate the MFU line uses.
+        agreement = _flops_agreement(
+            _lm_train_flops_per_token(), multi, (state, chunks),
+            LM_STEPS * LM_BATCH * LM_SEQ, devices=trial.size,
+        )
+        return (
+            float(np.median(rates)), rates, float(metrics["loss"][-1]),
+            agreement,
+        )
 
     variants = {"dense_xla": timed(None)}
     flash_error = None
@@ -812,7 +879,7 @@ def bench_lm() -> dict:
             # kernel failed exactly this way on its first hardware run).
             flash_error = repr(e)[:300]
     winner = max(variants, key=lambda k: variants[k][0])
-    tok_s, rates, final_loss = variants[winner]
+    tok_s, rates, final_loss, flops_agreement = variants[winner]
 
     ndev = len(jax.devices())
     flops = _lm_train_flops_per_token()
@@ -831,6 +898,10 @@ def bench_lm() -> dict:
                if flash_error else {}),
         },
         "train_flops_per_token": flops,
+        # Analytic-vs-cost_analysis agreement for the winner's program
+        # (unit: FLOPs per token): >10% disagreement is flagged so the
+        # MFU line below is auditable, not trust-me arithmetic.
+        "flops_agreement": flops_agreement,
         "mfu": round(tok_s / ndev * flops / peak, 5) if peak else None,
         "config": {
             "vocab": LM_VOCAB, "d_model": LM_DMODEL, "heads": LM_HEADS,

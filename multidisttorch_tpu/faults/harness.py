@@ -134,8 +134,29 @@ def run_chaos_bench(
     tel_dir = telemetry_dir or os.path.join(work_dir, "telemetry")
     from multidisttorch_tpu import telemetry
 
+    # The chaos drill runs with the anomaly layer armed for capture:
+    # the plan's SLOW fault (a 0.2s stall against ~ms steps) must both
+    # fire a straggler anomaly AND open a bounded profiler window whose
+    # trace lands under {tel_dir}/anomaly_traces — CI uploads it.
+    # Thresholds are tightened for the CI-sized sweep (the standard
+    # plan's stall lands as early as step ~7 of an 8-step epoch, so the
+    # detector must be warm after a handful of marks).
+    anomaly_cfg = telemetry.AnomalyConfig(
+        window=16,
+        min_samples=4,
+        z_threshold=4.0,
+        min_ratio=3.0,
+        cooldown_marks=8,
+        capture_steps=10,
+        capture_cooldown_s=5.0,
+    )
+
     t0 = time.time()
-    with telemetry.telemetry_run(tel_dir):
+    with telemetry.telemetry_run(
+        tel_dir,
+        anomaly=anomaly_cfg,
+        anomaly_capture_dir=os.path.join(tel_dir, "anomaly_traces"),
+    ):
         while True:
             try:
                 results = run_hpo(
@@ -272,6 +293,25 @@ def _export_telemetry(tel_dir: str, injector: FaultInjector) -> dict:
     # not the trace — build_trace sorts its output, so checking the
     # trace would pass by construction.
     raw_ts = [float(e.get("ts", 0.0)) for e in events]
+    # Device-books acceptance: the exported run summary must carry a
+    # per-trial MFU verdict (a float, or an explicit null WITH a
+    # reason) and a peak-memory field (null tolerated only where the
+    # backend reports no memory stats AND live-buffer accounting
+    # failed — 'graceful skip', never a missing key).
+    with open(paths["summary"]) as f:
+        summary = json.load(f)
+    trials = summary.get("trials", {})
+    device_books_ok = bool(trials) and all(
+        "mfu" in t
+        and ("peak_memory_bytes" in t)
+        and (t["mfu"] is not None or t.get("mfu_reason"))
+        for t in trials.values()
+    )
+    capture_dirs = [
+        (ev.get("data") or {}).get("log_dir")
+        for ev in events
+        if ev.get("kind") == "profiler_capture_started"
+    ]
     return {
         "dir": tel_dir,
         **paths,
@@ -284,6 +324,15 @@ def _export_telemetry(tel_dir: str, injector: FaultInjector) -> dict:
         "lane_refills_traced": count("lane_refill"),
         "trace_monotonic": raw_ts == sorted(raw_ts)
         and bool(trace.get("traceEvents")),
+        "device_books_in_summary": device_books_ok,
+        "anomalies_traced": sum(
+            1 for ev in events
+            if str(ev.get("kind", "")).startswith("anomaly_")
+        ),
+        "stragglers_traced": count("anomaly_step_straggler"),
+        "profiler_captures": [
+            d for d in capture_dirs if d and os.path.isdir(d)
+        ],
     }
 
 

@@ -91,6 +91,8 @@ from multidisttorch_tpu.train.steps import (
     state_shardings,
     wrap_step_with_hooks,
 )
+from multidisttorch_tpu.telemetry import device as tele_device
+from multidisttorch_tpu.telemetry.anomaly import get_monitor
 from multidisttorch_tpu.telemetry.events import get_bus
 from multidisttorch_tpu.telemetry.metrics import get_registry
 from multidisttorch_tpu.utils.imaging import save_image_grid
@@ -309,12 +311,17 @@ class _TrialRun:
         # compiled-step wrappers) always see the current step.
         self._step_no = 0
         self._epoch_base_step = 0
-        # Telemetry (both None when off — the zero-cost contract;
+        # Telemetry (all None when off — the zero-cost contract;
         # captured once so the hot loop pays one attribute read).
         # Step timings flow into the sweep-wide metrics registry under
-        # this trial's series key; lifecycle events ride the bus.
+        # this trial's series key; lifecycle events ride the bus; the
+        # anomaly monitor watches step times and epoch losses; the
+        # device books (cost analysis, memory watermarks) are recorded
+        # through _device_seam at the same guarded sites.
         self._mreg = get_registry()
         self._mkey = f"trial-{cfg.trial_id}"
+        self._amon = get_monitor()
+        self._cost_done = False
 
         if model_builder is None:
             model = VAE(hidden_dim=cfg.hidden_dim, latent_dim=cfg.latent_dim)
@@ -552,6 +559,38 @@ class _TrialRun:
         if self._verbose:
             log0(*args, trial=self.trial, level=level)
 
+    def _device_seam(self, dt, fn, args, *, steps: int = 1) -> None:
+        """Per-dispatch device-book seam (reached only with telemetry
+        ON — call sites sit inside the ``self._mreg is not None``
+        guard): record the compiled step's XLA cost analysis ONCE per
+        trial (shapes don't change after the first dispatch), then feed
+        the straggler detector the per-step time the registry just
+        measured (``dt`` is ``step_mark``'s return — no second clock
+        read)."""
+        if not self._cost_done:
+            self._cost_done = True
+            tele_device.record_step_cost(
+                self._mkey, fn, args, steps=steps,
+                devices=self.trial.devices,
+                trial_id=self.cfg.trial_id,
+                group_id=self.trial.group_id,
+                # Same shape bucket + same arg shapes = same compiled
+                # program up to scalar hypers: one AOT analysis serves
+                # every same-shape trial and every retry attempt.
+                cache_key=("single", stack_bucket_key(self.cfg)),
+            )
+            # The AOT lower+compile above took real wall time inside an
+            # open interval — re-open so the next mark doesn't charge
+            # the compile as one giant dispatch (it would inflate the
+            # dispatch p95, deflate MFU, and seed the straggler
+            # detector's baseline with a bogus sample).
+            self._mreg.step_series(self._mkey).open_interval()
+        if self._amon is not None and dt is not None:
+            self._amon.observe_step(
+                self._mkey, dt,
+                trial_id=self.cfg.trial_id, step=self._step_no,
+            )
+
     @contextmanager
     def _guard(self):
         """Collect writer-only host-I/O failures (image/checkpoint/
@@ -657,6 +696,12 @@ class _TrialRun:
         self._step_no = int(jax.device_get(self.state.step))
         for epoch in range(self._start_epoch, cfg.epochs + 1):
             self._epoch_base_step = self._step_no
+            # Fresh timing interval per epoch: the gap since the last
+            # mark holds boundary work (eval, checkpoint, a retry's
+            # backoff), not a dispatch — without the break it reads as
+            # one giant "step" and trips the straggler detector.
+            if self._mreg is not None:
+                self._mreg.step_series(self._mkey).open_interval()
             # On-device loss accumulation (mirrors the eval path below):
             # each batch's contribution is an async device add; the
             # single float() at the epoch boundary is the train loop's
@@ -694,7 +739,10 @@ class _TrialRun:
                     s = metrics["loss_sum"]  # on device, async
                     epoch_sum_dev = s if epoch_sum_dev is None else epoch_sum_dev + s
                     if self._mreg is not None:
-                        self._mreg.step_mark(self._mkey, s)
+                        dt = self._mreg.step_mark(self._mkey, s)
+                        self._device_seam(
+                            dt, self.train_step, (self.state, batch, rng)
+                        )
                     if i % cfg.log_interval == 0:
                         log_batch(epoch, i, metrics["loss_sum"])
                     yield  # hand the host loop to the next trial
@@ -719,7 +767,11 @@ class _TrialRun:
                             s if epoch_sum_dev is None else epoch_sum_dev + s
                         )
                         if self._mreg is not None:
-                            self._mreg.step_mark(self._mkey, s, steps=c)
+                            dt = self._mreg.step_mark(self._mkey, s, steps=c)
+                            self._device_seam(
+                                dt, self.multi_step,
+                                (self.state, chunk, rng), steps=c,
+                            )
                         # Every batch index that would have logged in the
                         # per-step loop still logs (there can be several
                         # per chunk when log_interval < fused_steps).
@@ -743,7 +795,11 @@ class _TrialRun:
                                 else epoch_sum_dev + s
                             )
                             if self._mreg is not None:
-                                self._mreg.step_mark(self._mkey, s)
+                                dt = self._mreg.step_mark(self._mkey, s)
+                                self._device_seam(
+                                    dt, self.train_step,
+                                    (self.state, chunk[j], rng),
+                                )
                             if (i0 + j) % cfg.log_interval == 0:
                                 log_batch(epoch, i0 + j, metrics["loss_sum"])
                     yield
@@ -751,6 +807,14 @@ class _TrialRun:
             # One fetch for the whole epoch's average (O(1)-syncs rule).
             self._host_syncs += 1
             avg = float(epoch_sum_dev) / n_per_epoch
+            # Device memory books ride the sync just paid (never the
+            # dispatch hot loop) — sampled BEFORE the divergence gate
+            # below so even a diverging trial's books close.
+            if self._mreg is not None:
+                tele_device.sample_memory(
+                    self._mkey, self.trial.devices, where="epoch",
+                    trial_id=cfg.trial_id, group_id=self.trial.group_id,
+                )
             # Divergence gate at the sync the loop already pays: a
             # non-finite epoch average is a terminal trial RESULT
             # (deterministic training replays the same NaN on retry) —
@@ -762,6 +826,12 @@ class _TrialRun:
                 step=self._step_no,
                 trial_id=cfg.trial_id,
             )
+            # Loss watch sees only finite losses: a non-finite average
+            # is already a *terminal* verdict, not a precursor.
+            if self._amon is not None:
+                self._amon.observe_loss(
+                    cfg.trial_id, epoch=epoch, train_loss=avg
+                )
             self._log(
                 "====> Epoch: {} Average loss: {:.4f}".format(epoch, avg)
             )
@@ -878,6 +948,16 @@ class _TrialRun:
                     jax.tree.map(lambda x: x.copy_to_host_async(), snap)
                     yield
                     host_state = jax.device_get(snap)
+                    # Checkpoint boundary is the trial's memory high-
+                    # water moment (the gathered/host-bound snapshot is
+                    # live alongside the training state) — sample it.
+                    if self._mreg is not None:
+                        tele_device.sample_memory(
+                            self._mkey, self.trial.devices,
+                            where="checkpoint",
+                            trial_id=cfg.trial_id,
+                            group_id=self.trial.group_id,
+                        )
                     meta = {
                         **asdict(cfg),
                         "completed_epochs": epoch,
@@ -1029,8 +1109,13 @@ class _StackedBucketRun:
         # (one series per group's bucket, lanes= tagging the live lane
         # count), never to a single lane — the per-lane effective rate
         # is derived in the registry (telemetry.metrics.StepSeries).
+        # Device books and straggler detection follow the same scoping:
+        # the bucket is the dispatch unit, so its compiled program's
+        # cost analysis and its step-time stream are bucket-keyed.
         self._mreg = get_registry()
         self._mkey = f"bucket-g{trial.group_id}"
+        self._amon = get_monitor()
+        self._cost_done = False
 
         self.model = VAE(
             hidden_dim=template.hidden_dim, latent_dim=template.latent_dim
@@ -1119,6 +1204,31 @@ class _StackedBucketRun:
     def _log(self, *args, level: int = logging.INFO):
         if self._verbose:
             log0(*args, trial=self.trial, level=level)
+
+    def _device_seam(self, dt, fn, args, *, steps: int = 1) -> None:
+        """The bucket's device-book seam (telemetry ON only — call
+        sites sit inside the ``self._mreg is not None`` guard). Cost
+        analysis covers the COMPILED lane count (the vmapped program
+        computes every lane, masked or live), recorded once per bucket;
+        per-dispatch step times feed the straggler detector under the
+        bucket key."""
+        if not self._cost_done:
+            self._cost_done = True
+            template = next(
+                lane for lane in self.lanes if lane is not None
+            )["cfg"]
+            tele_device.record_step_cost(
+                self._mkey, fn, args, steps=steps, lanes=len(self.lanes),
+                devices=self.trial.devices,
+                group_id=self.trial.group_id,
+                cache_key=(
+                    "bucket", stack_bucket_key(template), len(self.lanes)
+                ),
+            )
+            # Re-open after the AOT compile (see _TrialRun._device_seam).
+            self._mreg.step_series(self._mkey).open_interval()
+        if self._amon is not None and dt is not None:
+            self._amon.observe_step(self._mkey, dt)
 
     def _emit_lane(self, kind: str, lane_k: int, trial_id=None, **data):
         """Lane-churn telemetry (retire/refill/fault/diverge/mask)."""
@@ -1436,6 +1546,13 @@ class _StackedBucketRun:
             )
             self.data.set_lane(k, nxt.seed)
             self._emit_lane("lane_refill", k, trial_id=nxt.trial_id)
+            # Refill swaps a fresh lane state into the stacked tree —
+            # a watermark moment (old + new lane buffers both live).
+            if self._mreg is not None:
+                tele_device.sample_memory(
+                    self._mkey, self.trial.devices, where="lane_refill",
+                    group_id=self.trial.group_id,
+                )
             self._log(
                 f"Trial {nxt.trial_id} refilled into stacked lane {k} "
                 "(no recompilation)"
@@ -1476,6 +1593,11 @@ class _StackedBucketRun:
             # round boundaries, so this tags every dispatch's metrics
             # mark with the bucket's true occupancy.
             k_live = sum(lane is not None for lane in self.lanes)
+            # Fresh timing interval per round (see _TrialRun.run): the
+            # gap since the last mark is boundary work — eval, lane
+            # retirement/refill — not a dispatch.
+            if self._mreg is not None:
+                self._mreg.step_series(self._mkey).open_interval()
 
             def add(dev_sums):
                 nonlocal round_sum_dev
@@ -1494,8 +1616,13 @@ class _StackedBucketRun:
                     self._bump_steps(1)
                     add(m["loss_sum"])
                     if self._mreg is not None:
-                        self._mreg.step_mark(
+                        dt = self._mreg.step_mark(
                             self._mkey, round_sum_dev, lanes=k_live
+                        )
+                        self._device_seam(
+                            dt, self.sstep,
+                            (self.state, self.hypers, batch,
+                             self.base_rngs, self._lane_steps()),
                         )
                     yield
             else:
@@ -1509,9 +1636,15 @@ class _StackedBucketRun:
                         self._bump_steps(s)
                         add(m["loss_sum"].sum(axis=0))
                         if self._mreg is not None:
-                            self._mreg.step_mark(
+                            dt = self._mreg.step_mark(
                                 self._mkey, round_sum_dev,
                                 steps=s, lanes=k_live,
+                            )
+                            self._device_seam(
+                                dt, self.smulti,
+                                (self.state, self.hypers, chunk,
+                                 self.base_rngs, self._lane_steps()),
+                                steps=s,
                             )
                     else:
                         # Tail shorter than the compiled chunk: per-step
@@ -1524,8 +1657,13 @@ class _StackedBucketRun:
                             self._bump_steps(1)
                             add(m["loss_sum"])
                             if self._mreg is not None:
-                                self._mreg.step_mark(
+                                dt = self._mreg.step_mark(
                                     self._mkey, round_sum_dev, lanes=k_live
+                                )
+                                self._device_seam(
+                                    dt, self.sstep,
+                                    (self.state, self.hypers, chunk[j],
+                                     self.base_rngs, self._lane_steps()),
                                 )
                     yield
 
@@ -1533,6 +1671,12 @@ class _StackedBucketRun:
             # the bucket pays per-round what one trial used to pay).
             self._host_syncs += 1
             train_sums = np.asarray(round_sum_dev)
+            # Memory books ride the round boundary's existing sync.
+            if self._mreg is not None:
+                tele_device.sample_memory(
+                    self._mkey, self.trial.devices, where="round",
+                    group_id=self.trial.group_id,
+                )
 
             test_sums = None
             if self.test_iter is not None:
@@ -1585,6 +1729,14 @@ class _StackedBucketRun:
                         group_id=self.trial.group_id,
                         step=lane["steps"],
                         **record,
+                    )
+                if self._amon is not None:
+                    self._amon.observe_loss(
+                        lane["cfg"].trial_id,
+                        epoch=lane["epochs_done"],
+                        train_loss=avg,
+                        lane=k,
+                        group_id=self.trial.group_id,
                     )
                 if lane["epochs_done"] >= lane["cfg"].epochs:
                     retiring.append(k)

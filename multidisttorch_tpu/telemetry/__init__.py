@@ -44,11 +44,14 @@ import contextlib
 import os
 from typing import Optional
 
+from multidisttorch_tpu.telemetry import anomaly as _anomaly
 from multidisttorch_tpu.telemetry import events as _events
 from multidisttorch_tpu.telemetry import metrics as _metrics
 
 get_bus = _events.get_bus
 get_registry = _metrics.get_registry
+get_monitor = _anomaly.get_monitor
+AnomalyConfig = _anomaly.AnomalyConfig
 read_events = _events.read_events
 EVENTS_NAME = _events.EVENTS_NAME
 
@@ -63,10 +66,16 @@ def configure(
     *,
     queue_max: int = 4096,
     device_sample_every: int = 100,
+    anomaly: Optional["AnomalyConfig"] = None,
+    anomaly_capture_dir: Optional[str] = None,
 ) -> None:
     """Turn telemetry ON: create the event bus (JSONL sink under
-    ``out_dir`` when given, in-memory only otherwise) and the metrics
-    registry, and install the best-effort compile listener."""
+    ``out_dir`` when given, in-memory only otherwise), the metrics
+    registry, and the anomaly monitor (``anomaly=`` tunes thresholds;
+    ``anomaly_capture_dir=`` additionally arms the bounded profiler
+    capture — off by default, since only one profiler session can
+    exist per process), and install the best-effort compile
+    listener."""
     path = None
     if out_dir is not None:
         os.makedirs(out_dir, exist_ok=True)
@@ -83,11 +92,21 @@ def configure(
         path = os.path.join(out_dir, name)
     _events.configure(path=path, queue_max=queue_max)
     _metrics.configure(device_sample_every=device_sample_every)
+    if anomaly_capture_dir is not None:
+        import dataclasses
+
+        anomaly = dataclasses.replace(
+            anomaly or _anomaly.AnomalyConfig(),
+            capture_dir=anomaly_capture_dir,
+        )
+    _anomaly.configure(anomaly)
     _metrics.install_compile_listener()
 
 
 def disable() -> None:
-    """Turn telemetry OFF (close the sink, drop bus and registry)."""
+    """Turn telemetry OFF (close the sink, stop any profiler window,
+    drop bus, registry, and anomaly monitor)."""
+    _anomaly.disable()
     _events.disable()
     _metrics.disable()
 
@@ -103,7 +122,20 @@ def configure_from_env() -> bool:
     flag = os.environ.get("MDT_TELEMETRY", "").strip().lower()
     if flag in ("", "0", "false", "off"):
         return False
-    configure(os.environ.get("MDT_TELEMETRY_DIR", "telemetry"))
+    out_dir = os.environ.get("MDT_TELEMETRY_DIR", "telemetry")
+    # MDT_TELEMETRY_CAPTURE=1 additionally arms anomaly-triggered
+    # profiler capture windows (bounded/rate-limited; traces land under
+    # {dir}/anomaly_traces). Off by default: jax allows one profiler
+    # session per process and an explicit profile_dir= must win.
+    cap = os.environ.get("MDT_TELEMETRY_CAPTURE", "").strip().lower()
+    configure(
+        out_dir,
+        anomaly_capture_dir=(
+            os.path.join(out_dir, "anomaly_traces")
+            if cap not in ("", "0", "false", "off")
+            else None
+        ),
+    )
     return True
 
 
@@ -121,11 +153,13 @@ def telemetry_run(out_dir: Optional[str] = None, **kwargs):
 
 __all__ = [
     "EVENTS_NAME",
+    "AnomalyConfig",
     "configure",
     "configure_from_env",
     "disable",
     "enabled",
     "get_bus",
+    "get_monitor",
     "get_registry",
     "read_events",
     "telemetry_run",

@@ -1,0 +1,375 @@
+"""Online anomaly detection: stragglers, loss plateaus, divergence
+precursors — with an optional anomaly-triggered profiler capture.
+
+MPMD-scale pipeline work (PAPERS: "Scaling Deep Learning Training with
+MPMD Pipeline Parallelism") makes the case that straggler detection
+must be *online*: a slow step explained after the sweep is a trace you
+no longer have. This module watches the streams the PR 3 telemetry
+already pays for — per-dispatch step times (fed back from
+``StepSeries.mark``'s return value, no second clock read) and
+epoch-boundary losses (the sync the loop already pays) — and emits
+typed ``anomaly_*`` events on the bus the moment something drifts:
+
+- **Straggler detector**: rolling robust z-score (median/MAD — a
+  straggler must not drag its own baseline the way a mean/std would)
+  over each series' per-step dispatch times, plus a ratio floor so
+  microsecond-scale timer jitter on a quantized clock can never flag.
+  Emits ``anomaly_step_straggler`` (dt, median, z), rate-limited by a
+  per-series cooldown so one slow *phase* is one anomaly, not a flood.
+- **Loss watch**: per trial, ``anomaly_loss_plateau`` when the best
+  loss stops improving for ``plateau_epochs`` epochs (relative eps),
+  and ``anomaly_divergence_precursor`` when a still-finite loss blows
+  past ``diverge_ratio`` x its own best or rises ``diverge_epochs``
+  epochs straight — the signal *before* the NaN that
+  ``train/guards.py`` turns into a terminal verdict.
+- **Profiler capture** (off unless ``capture_dir`` is set): a flagged
+  straggler can open a *bounded* ``jax.profiler`` window
+  (``utils.profiling.profile_window(dir, steps=N)``) so the trace that
+  explains the slow step is captured while it is still happening.
+  Hard-bounded: at most ``max_captures_per_key`` windows per series,
+  one window active process-wide, a wall-clock cooldown between
+  windows, and every window closes itself after ``capture_steps``
+  marks.
+
+Zero-cost-when-off: module state is ``None`` until :func:`configure`
+(installed by ``telemetry.configure`` alongside the bus/registry);
+every driver seam guards with ``mon = get_monitor(); if mon is not
+None:`` — OFF constructs no detector objects (tier-1-enforced). When
+on, the per-mark cost is one deque append plus, past warm-up, two
+medians over a <=``window``-sample buffer — microseconds, inside the
+<=2% budget the bench A/B enforces.
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+from multidisttorch_tpu.telemetry.events import get_bus
+from multidisttorch_tpu.telemetry.metrics import get_registry
+
+STRAGGLER = "anomaly_step_straggler"
+LOSS_PLATEAU = "anomaly_loss_plateau"
+DIVERGENCE_PRECURSOR = "anomaly_divergence_precursor"
+PROFILER_CAPTURE = "profiler_capture_started"
+
+
+@dataclass(frozen=True)
+class AnomalyConfig:
+    """Detector thresholds (docs/OBSERVABILITY.md explains tuning).
+
+    ``z_threshold`` is in robust standard deviations (MAD-scaled);
+    ``min_ratio`` additionally requires the flagged step to be that
+    multiple of the rolling median, so quantized-timer jitter around a
+    microsecond median can never fire. ``capture_dir=None`` (default)
+    disables profiler capture entirely."""
+
+    window: int = 32          # rolling samples per series
+    min_samples: int = 8      # warm-up before any verdict
+    z_threshold: float = 6.0
+    min_ratio: float = 2.0
+    cooldown_marks: int = 16  # marks suppressed after a straggler event
+    plateau_epochs: int = 4
+    plateau_rel_eps: float = 1e-3
+    diverge_ratio: float = 2.0
+    diverge_epochs: int = 3
+    capture_dir: Optional[str] = None
+    capture_steps: int = 25
+    max_captures_per_key: int = 2
+    capture_cooldown_s: float = 30.0
+
+
+class RollingRobustZ:
+    """Rolling robust z-score over the last ``window`` observations.
+
+    ``observe(x)`` scores x against the window's median/MAD baseline
+    (with a jitter floor), then admits it — so an outlier is judged by
+    the baseline it disrupted, not by a window it already polluted.
+    Returns ``(z, median)`` once warm (``min_samples``), else None.
+
+    Hot-path discipline: the median/MAD pair is CACHED and recomputed
+    only every ``window//2`` observations (and once at warm-up), so the
+    steady-state per-observe cost is a deque append plus two float ops
+    — the two O(window log window) medians amortize to ~100 ns/mark.
+    The baseline therefore lags a regime change by at most half a
+    window, which is exactly the lag a straggler detector wants: a
+    slow PHASE keeps flagging until the window rolls over to the new
+    normal.
+    """
+
+    __slots__ = ("_buf", "_min", "_refresh", "_since", "_med", "_scale")
+
+    def __init__(self, window: int = 32, min_samples: int = 8):
+        self._buf: deque = deque(maxlen=max(2, int(window)))
+        self._min = max(2, int(min_samples))
+        self._refresh = max(4, int(window) // 2)
+        self._since = 0
+        self._med: Optional[float] = None
+        self._scale = 1.0
+
+    def _recompute(self) -> None:
+        vals = list(self._buf)
+        med = statistics.median(vals)
+        mad = statistics.median(abs(v - med) for v in vals)
+        # Floor the scale at 5% of the median (timer-quantization
+        # jitter) so identical samples (MAD 0) give a finite z.
+        self._med = med
+        self._scale = max(1.4826 * mad, 0.05 * abs(med), 1e-9)
+        self._since = 0
+
+    def observe(self, x: float) -> Optional[tuple]:
+        out = None
+        if len(self._buf) >= self._min:
+            if self._med is None or self._since >= self._refresh:
+                self._recompute()
+            out = ((x - self._med) / self._scale, self._med)
+        self._buf.append(x)
+        self._since += 1
+        return out
+
+
+class AnomalyMonitor:
+    """The process-local anomaly monitor (construct via
+    :func:`configure`). One straggler detector per step series, one
+    loss watch per trial, at most one profiler window at a time."""
+
+    def __init__(self, config: Optional[AnomalyConfig] = None,
+                 window_factory=None):
+        self.config = config or AnomalyConfig()
+        if window_factory is None:
+            from multidisttorch_tpu.utils.profiling import profile_window
+
+            window_factory = profile_window
+        self._window_factory = window_factory
+        self._step_dets: dict = {}
+        self._cooldown: dict = {}
+        self._loss: dict = {}
+        self._captures: dict = {}
+        self._active_window = None
+        self._last_capture_t: Optional[float] = None
+        self.anomalies = 0
+
+    # -- step-time straggler detection ------------------------------
+
+    def observe_step(
+        self,
+        key: str,
+        dt_s: float,
+        *,
+        trial_id: Optional[int] = None,
+        lane: Optional[int] = None,
+        step: Optional[int] = None,
+    ) -> Optional[dict]:
+        """Feed one dispatch's per-step seconds for series ``key``
+        (called from the driver right after ``step_mark`` with its
+        return value). Returns the anomaly record when one fired."""
+        w = self._active_window
+        if w is not None:
+            w.tick()
+            if not w.active:
+                self._active_window = None
+        det = self._step_dets.get(key)
+        if det is None:
+            det = self._step_dets[key] = RollingRobustZ(
+                self.config.window, self.config.min_samples
+            )
+        scored = det.observe(dt_s)
+        cool = self._cooldown.get(key, 0)
+        if cool > 0:
+            self._cooldown[key] = cool - 1
+            return None
+        if scored is None:
+            return None
+        z, med = scored
+        cfg = self.config
+        if z < cfg.z_threshold or med <= 0 or dt_s < cfg.min_ratio * med:
+            return None
+        self._cooldown[key] = cfg.cooldown_marks
+        self.anomalies += 1
+        rec = {
+            "key": key,
+            "step_time_s": round(dt_s, 6),
+            "median_s": round(med, 6),
+            "z": round(min(z, 1e9), 2),
+            "ratio": round(dt_s / med, 2),
+        }
+        reg = get_registry()
+        if reg is not None:
+            reg.counter("anomalies_total", kind="straggler").inc()
+        bus = get_bus()
+        if bus is not None:
+            bus.emit(
+                STRAGGLER, trial_id=trial_id, lane=lane, step=step, **rec
+            )
+        capture = self._maybe_capture(key, trial_id=trial_id, step=step)
+        if capture is not None:
+            rec["capture"] = capture
+        return rec
+
+    # -- loss plateau / divergence precursor ------------------------
+
+    def observe_loss(
+        self,
+        trial_id: int,
+        *,
+        epoch: int,
+        train_loss: float,
+        lane: Optional[int] = None,
+        group_id: Optional[int] = None,
+    ) -> Optional[str]:
+        """Feed one trial's epoch-average train loss (the boundary sync
+        the loop already pays). Returns the anomaly kind when one
+        fired. Non-finite losses are ignored here — they are already a
+        *terminal* divergence verdict (train/guards.py), not a
+        precursor."""
+        if not math.isfinite(train_loss):
+            return None
+        st = self._loss.get(trial_id)
+        if st is None:
+            st = self._loss[trial_id] = {
+                "best": train_loss,
+                "since_best": 0,
+                "prev": None,
+                "rising": 0,
+                "plateau_done": False,
+                "precursor_done": False,
+            }
+            return None
+        cfg = self.config
+        prev = st["prev"] if st["prev"] is not None else train_loss
+        st["rising"] = st["rising"] + 1 if train_loss > prev else 0
+        st["prev"] = train_loss
+        if train_loss < st["best"] * (1.0 - cfg.plateau_rel_eps):
+            st["best"] = train_loss
+            st["since_best"] = 0
+        else:
+            st["since_best"] += 1
+        fired = None
+        if not st["precursor_done"] and (
+            (st["best"] > 0 and train_loss >= cfg.diverge_ratio * st["best"])
+            or st["rising"] >= cfg.diverge_epochs
+        ):
+            st["precursor_done"] = True
+            fired = DIVERGENCE_PRECURSOR
+            data = {
+                "train_loss": train_loss,
+                "best_loss": st["best"],
+                "rising_epochs": st["rising"],
+            }
+        elif not st["plateau_done"] and (
+            st["since_best"] >= cfg.plateau_epochs
+        ):
+            st["plateau_done"] = True
+            fired = LOSS_PLATEAU
+            data = {
+                "train_loss": train_loss,
+                "best_loss": st["best"],
+                "epochs_since_improvement": st["since_best"],
+            }
+        if fired is None:
+            return None
+        self.anomalies += 1
+        reg = get_registry()
+        if reg is not None:
+            reg.counter(
+                "anomalies_total",
+                kind=fired.replace("anomaly_", ""),
+            ).inc()
+        bus = get_bus()
+        if bus is not None:
+            bus.emit(
+                fired,
+                trial_id=trial_id,
+                lane=lane,
+                group_id=group_id,
+                epoch=epoch,
+                **data,
+            )
+        return fired
+
+    # -- bounded, rate-limited profiler capture ----------------------
+
+    def captures_started(self, key: Optional[str] = None) -> int:
+        if key is not None:
+            return self._captures.get(key, 0)
+        return sum(self._captures.values())
+
+    def _maybe_capture(self, key, *, trial_id=None, step=None):
+        cfg = self.config
+        if cfg.capture_dir is None or self._active_window is not None:
+            return None
+        if self._captures.get(key, 0) >= cfg.max_captures_per_key:
+            return None
+        now = time.monotonic()
+        if (
+            self._last_capture_t is not None
+            and now - self._last_capture_t < cfg.capture_cooldown_s
+        ):
+            return None
+        import os
+
+        n = self._captures.get(key, 0)
+        log_dir = os.path.join(cfg.capture_dir, f"{key}-{n}")
+        try:
+            w = self._window_factory(log_dir, steps=cfg.capture_steps)
+        except Exception:  # noqa: BLE001 — capture is best-effort
+            return None
+        if not getattr(w, "active", False):
+            return None
+        self._captures[key] = n + 1
+        self._last_capture_t = now
+        self._active_window = w
+        reg = get_registry()
+        if reg is not None:
+            reg.counter("profiler_captures").inc()
+        bus = get_bus()
+        if bus is not None:
+            bus.emit(
+                PROFILER_CAPTURE,
+                trial_id=trial_id,
+                step=step,
+                key=key,
+                log_dir=log_dir,
+                steps=cfg.capture_steps,
+                capture_index=n,
+            )
+        return log_dir
+
+    def close(self) -> None:
+        """Stop any in-flight profiler window (telemetry teardown)."""
+        w, self._active_window = self._active_window, None
+        if w is not None:
+            try:
+                w.stop()
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+
+
+_monitor: Optional[AnomalyMonitor] = None
+
+
+def get_monitor() -> Optional[AnomalyMonitor]:
+    """The active monitor, or ``None`` when telemetry is off. Hot-path
+    seams branch on this — the off cost is one global read."""
+    return _monitor
+
+
+def configure(
+    config: Optional[AnomalyConfig] = None, window_factory=None
+) -> AnomalyMonitor:
+    global _monitor
+    if _monitor is not None:
+        _monitor.close()
+    _monitor = AnomalyMonitor(config, window_factory=window_factory)
+    return _monitor
+
+
+def disable() -> None:
+    global _monitor
+    if _monitor is not None:
+        _monitor.close()
+    _monitor = None

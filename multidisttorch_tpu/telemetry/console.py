@@ -52,6 +52,27 @@ def fmt_rate(v: Optional[float], unit: str = "/s") -> str:
     return f"{v:.2f}{unit}"
 
 
+def fmt_bytes(v: Optional[float]) -> str:
+    """Compact byte count: 512B / 3.4KB / 120MB / 1.5GB."""
+    if v is None:
+        return "-"
+    v = float(v)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if v < 1024.0 or unit == "TB":
+            if unit == "B":
+                return f"{v:.0f}B"
+            return f"{v:.1f}{unit}" if v < 10 else f"{v:.0f}{unit}"
+        v /= 1024.0
+    return "-"
+
+
+def fmt_mfu(v: Optional[float]) -> str:
+    """MFU as a percent (the device books' utilization verdict)."""
+    if v is None:
+        return "-"
+    return f"{v * 100:.1f}%"
+
+
 def fmt_ts(ts: Optional[float]) -> str:
     if ts is None:
         return "-"

@@ -94,6 +94,27 @@ def build_trace(events: list[dict]) -> dict:
             if k not in ("kind", "ts", "data")
         }
         args.update(ev.get("data") or {})
+        if kind == "device_memory":
+            # Device-memory samples render as a Perfetto COUNTER track
+            # per series (one line chart across the sweep), not as
+            # instants — watermark shape is the whole point.
+            data = ev.get("data") or {}
+            series = {}
+            if data.get("bytes_in_use") is not None:
+                series["bytes_in_use"] = data["bytes_in_use"]
+            if data.get("peak_bytes") is not None:
+                series["peak_bytes"] = data["peak_bytes"]
+            if series:
+                out.append(
+                    {
+                        "name": f"device_memory[{data.get('key', '?')}]",
+                        "ph": "C",
+                        "pid": 1,
+                        "ts": us(ts),
+                        "args": series,
+                    }
+                )
+            continue
         if kind == "attempt_start":
             open_attempts[(ev.get("trial_id"), ev.get("attempt"))] = ev
             continue
@@ -241,6 +262,11 @@ class SweepFold:
         self.useful = 0
         self.executed = 0
         self.done = False
+        # Device books folded off device_cost / device_memory events,
+        # keyed by step-series key ("trial-3" / "bucket-g0") — the live
+        # console's copy of what the registry holds in-process.
+        self.device: dict[str, dict] = {}
+        self.anomalies = 0
 
     def _trial(self, tid: int) -> dict:
         return self.trials.setdefault(
@@ -256,10 +282,27 @@ class SweepFold:
                 "faults": 0,
                 "lane_events": 0,
                 "lane": None,
+                "group": None,
+                "anomalies": 0,
                 "first_ts": None,
                 "last_ts": None,
             },
         )
+
+    def series_key_of(self, tid: int) -> Optional[str]:
+        """The step-series key trial ``tid``'s device books live under:
+        its own series when it ran classic, its bucket's when stacked."""
+        t = self.trials.get(tid)
+        if t is None:
+            return None
+        key = f"trial-{tid}"
+        if key in self.device:
+            return key
+        if t.get("lane") is not None and t.get("group") is not None:
+            bkey = f"bucket-g{t['group']}"
+            if bkey in self.device:
+                return bkey
+        return None
 
     def feed(self, ev: dict) -> None:
         self.events += 1
@@ -273,6 +316,21 @@ class SweepFold:
             self.sweep = ev.get("data") or {}
         elif kind == "sweep_end":
             self.done = True
+        if kind in ("device_cost", "device_memory"):
+            data = ev.get("data") or {}
+            key = data.get("key")
+            if key:
+                book = self.device.setdefault(key, {})
+                if kind == "device_cost":
+                    book.update(data)
+                else:
+                    for f in ("bytes_in_use", "peak_bytes"):
+                        v = data.get(f)
+                        if v is not None:
+                            book[f] = max(book.get(f) or 0, int(v))
+                    book["memory_source"] = data.get("source")
+        if kind.startswith("anomaly_"):
+            self.anomalies += 1
         tid = ev.get("trial_id")
         if tid is None:
             return
@@ -282,6 +340,8 @@ class SweepFold:
             t["first_ts"] = ts
         if ev.get("lane") is not None:
             t["lane"] = ev["lane"]
+        if ev.get("group_id") is not None:
+            t["group"] = ev["group_id"]
         data = ev.get("data") or {}
         if kind == "attempt_start":
             t["attempts"] = max(t["attempts"], int(ev.get("attempt") or 0))
@@ -308,10 +368,78 @@ class SweepFold:
             t["faults"] += 1
         elif kind.startswith("lane_"):
             t["lane_events"] += 1
+        elif kind.startswith("anomaly_"):
+            t["anomalies"] += 1
 
     @property
     def goodput(self) -> Optional[float]:
         return self.useful / self.executed if self.executed else None
+
+
+def _attach_device_books(fold: SweepFold, registry) -> dict:
+    """Join the registry's device books (MFU, roofline, watermarks —
+    telemetry/device.py) with the event fold, and stamp every trial
+    with its ``mfu`` / ``peak_memory_bytes`` verdict. The contract is
+    EXPLICIT nulls: a trial whose MFU cannot be computed (no cost
+    analysis on this backend, no known peak FLOP/s, no timings) gets
+    ``mfu: null`` plus ``mfu_reason`` saying why — never a silently
+    missing field, never a made-up number."""
+    from multidisttorch_tpu.telemetry import device as _device
+
+    books = _device.device_books(registry) if registry is not None else {}
+    # Post-hoc path (reading a finished run's JSONL, no live registry):
+    # fold the event-carried books instead; event-carried cost-analysis
+    # failure reasons also enrich the registry books.
+    for key, eb in fold.device.items():
+        if key in books:
+            b = books[key]
+            if b.get("mfu") is None and eb.get("reason"):
+                b["mfu_reason"] = eb["reason"]
+            if b.get("peak_memory_bytes") is None and eb.get("peak_bytes"):
+                b["peak_memory_bytes"] = eb["peak_bytes"]
+            b.setdefault("memory_source", eb.get("memory_source"))
+        else:
+            books[key] = {
+                "key": key,
+                "flops_per_step": eb.get("flops_per_lane_step"),
+                "bytes_per_step": eb.get("bytes_per_lane_step"),
+                "peak_flops_per_chip": eb.get("peak_flops_per_chip"),
+                "devices": eb.get("devices"),
+                "mfu": None,
+                "mfu_reason": (
+                    eb.get("reason")
+                    or "no live metrics registry (post-hoc summary from "
+                    "the event stream only — step timings not recorded)"
+                ),
+                "roofline": _device.roofline_class(
+                    eb.get("flops_per_lane_step"),
+                    eb.get("bytes_per_lane_step"),
+                    eb.get("peak_flops_per_chip"),
+                    eb.get("peak_membw_per_chip"),
+                ),
+                "peak_memory_bytes": eb.get("peak_bytes"),
+                "memory_source": eb.get("memory_source"),
+            }
+    for tid, t in fold.trials.items():
+        key = f"trial-{tid}"
+        if key not in books and t.get("group") is not None:
+            bkey = f"bucket-g{t['group']}"
+            if bkey in books:
+                key = bkey
+        book = books.get(key)
+        if book is None:
+            t["mfu"] = None
+            t["mfu_reason"] = "no device books recorded for this trial"
+            t["peak_memory_bytes"] = None
+            t["roofline"] = None
+            continue
+        t["device_series"] = key
+        t["mfu"] = book.get("mfu")
+        if t["mfu"] is None:
+            t["mfu_reason"] = book.get("mfu_reason")
+        t["roofline"] = book.get("roofline")
+        t["peak_memory_bytes"] = book.get("peak_memory_bytes")
+    return books
 
 
 def run_summary(
@@ -320,13 +448,16 @@ def run_summary(
 ) -> dict:
     """Sweep-level rollup of an event stream (+ metrics snapshot when a
     registry is live): per-trial attempt/status/retry accounting, fault
-    and lane-churn counts, and the goodput ratio (useful/executed
-    optimizer steps — the chaos bench's accounting, derived here from
-    ``attempt_end`` summaries instead of the ledger file)."""
+    and lane-churn counts, the goodput ratio (useful/executed optimizer
+    steps — the chaos bench's accounting, derived here from
+    ``attempt_end`` summaries instead of the ledger file), and the
+    device books — per-trial MFU (explicit null-with-reason where it
+    cannot be computed), roofline class, and peak-memory watermarks."""
     registry = registry or _metrics.get_registry()
     fold = SweepFold()
     for ev in events:
         fold.feed(ev)
+    books = _attach_device_books(fold, registry)
     out = {
         "events": fold.events,
         "by_kind": dict(sorted(fold.by_kind.items())),
@@ -336,6 +467,8 @@ def run_summary(
         "goodput": (
             round(fold.goodput, 4) if fold.goodput is not None else None
         ),
+        "device_books": {k: books[k] for k in sorted(books)},
+        "anomalies": fold.anomalies,
     }
     if registry is not None:
         out["metrics"] = registry.snapshot()

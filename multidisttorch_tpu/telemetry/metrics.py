@@ -55,6 +55,13 @@ class Gauge:
     def set(self, v: float) -> None:
         self.value = float(v)
 
+    def set_max(self, v: float) -> None:
+        """Watermark semantics: keep the high-water mark (the device
+        memory books' peak gauges)."""
+        v = float(v)
+        if v > self.value:
+            self.value = v
+
 
 class Histogram:
     """Fixed-bucket histogram with percentile estimates.
@@ -137,15 +144,26 @@ class StepSeries:
         self._marks = 0
         self._sample_every = max(0, int(sample_every))
 
-    def mark(self, value=None, *, steps: int = 1, lanes: int = 1) -> None:
+    def mark(
+        self, value=None, *, steps: int = 1, lanes: int = 1
+    ) -> Optional[float]:
         """Close one dispatch interval. ``value``, when given, enables
         the sparse device-inclusive sample: every ``sample_every``-th
         mark blocks on it (``jax.block_until_ready``) so the interval
-        includes device execution, not just host enqueue."""
+        includes device execution, not just host enqueue.
+
+        Returns the observed per-step seconds for DISPATCH marks (None
+        for the opening mark) — the anomaly layer's straggler detector
+        feeds on it without a second clock read. Device-synced samples
+        return None too: a block_until_ready interval includes the
+        drained backlog of every in-flight dispatch, which on an async
+        backend is orders of magnitude above the dispatch median —
+        feeding it to the detector would fire a false straggler (and
+        burn a capture window) every sample_every marks."""
         now = time.perf_counter()
         if self._last is None:
             self._last = now
-            return
+            return None
         self._marks += 1
         synced = False
         if (
@@ -166,6 +184,15 @@ class StepSeries:
         self.steps += steps
         self.lane_steps += steps * lanes
         self.total_s += dt
+        return None if synced else per_step
+
+    def open_interval(self) -> None:
+        """Break the measurement chain: the next mark OPENS a fresh
+        interval instead of closing one that spans non-dispatch work.
+        Called at epoch/attempt boundaries (eval loops, checkpoint
+        writes, retry backoff gaps) so neither the dispatch books nor
+        the straggler detector read boundary work as a slow step."""
+        self._last = None
 
     def snapshot(self) -> dict:
         out = {
@@ -236,9 +263,26 @@ class MetricsRegistry:
 
     def step_mark(
         self, key: str, value=None, *, steps: int = 1, lanes: int = 1
-    ) -> None:
-        """The driver's per-dispatch seam (see :class:`StepSeries`)."""
-        self.step_series(key).mark(value, steps=steps, lanes=lanes)
+    ) -> Optional[float]:
+        """The driver's per-dispatch seam (see :class:`StepSeries`).
+        Returns the observed per-step seconds (None on the opening
+        mark) so the caller can feed the anomaly detector for free."""
+        return self.step_series(key).mark(value, steps=steps, lanes=lanes)
+
+    def gauge_value(self, name: str, **labels) -> Optional[float]:
+        """Read a gauge WITHOUT creating it (None when absent) — the
+        device-books join reads many maybe-absent gauges and must not
+        pollute the registry with zeros."""
+        k = self._key(name, labels)
+        with self._lock:
+            g = self._gauges.get(k)
+        return None if g is None else g.value
+
+    def step_series_snapshots(self) -> dict:
+        """``{key: snapshot}`` for every step series (no creation)."""
+        with self._lock:
+            items = list(self._steps.items())
+        return {k: s.snapshot() for k, s in items}
 
     def snapshot(self) -> dict:
         """Everything, JSON-ready — the run-summary's metrics block."""

@@ -766,6 +766,11 @@ def wrap_step_with_hooks(
             args[batch_argnum] = transform_batch(batch)
         return step_fn(*args, **kwargs)
 
+    # Keep the compiled function reachable through the wrapper: the
+    # device cost books (telemetry/device.py) need ``.lower()`` on the
+    # underlying jit fn to run XLA's cost analysis on the program that
+    # actually dispatches.
+    hooked.__wrapped__ = step_fn
     return hooked
 
 

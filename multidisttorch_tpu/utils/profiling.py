@@ -38,6 +38,80 @@ def profile_trace(log_dir: str):
         yield
 
 
+# One JAX profiler session may be active per process; ProfileWindow
+# tracks its own so a second window degrades to a no-start instead of
+# the profiler's RuntimeError.
+_window_active = False
+
+
+class ProfileWindow:
+    """A bounded on-demand profiler capture: ``start()`` opens a
+    ``jax.profiler`` trace, every ``tick()`` counts one dispatched
+    step, and the window closes itself after ``steps`` ticks (or on an
+    explicit :meth:`stop`).
+
+    Built for the anomaly layer (``telemetry/anomaly.py``): when a
+    straggler is flagged, the capture opens *while the slow phase is
+    still running*, records the next N steps' device timeline, and
+    stops — a trace small enough to keep and triggered exactly when it
+    explains something. Best-effort throughout: a failed start (another
+    session active, backend without profiler support) leaves
+    ``active=False`` with the reason in ``error`` and never raises.
+    """
+
+    def __init__(self, log_dir: str, steps: int = 25):
+        self.log_dir = log_dir
+        self.remaining = max(1, int(steps))
+        self.active = False
+        self.error = None
+
+    def start(self) -> bool:
+        global _window_active
+        if _window_active:
+            self.error = "another profiler window is already active"
+            return False
+        import jax
+
+        try:
+            jax.profiler.start_trace(self.log_dir)
+        except Exception as e:  # noqa: BLE001 — capture is best-effort
+            self.error = f"{type(e).__name__}: {e}"
+            return False
+        self.active = True
+        _window_active = True
+        return True
+
+    def tick(self) -> None:
+        """Count one step; stop the trace when the window is spent."""
+        if not self.active:
+            return
+        self.remaining -= 1
+        if self.remaining <= 0:
+            self.stop()
+
+    def stop(self) -> None:
+        global _window_active
+        if not self.active:
+            return
+        self.active = False
+        _window_active = False
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001 — teardown is best-effort
+            self.error = f"{type(e).__name__}: {e}"
+
+
+def profile_window(log_dir: str, *, steps: int = 25) -> ProfileWindow:
+    """Start a bounded profiler capture window of ``steps`` dispatches
+    (see :class:`ProfileWindow`; ``active`` is False when the start
+    failed — e.g. a window is already open)."""
+    w = ProfileWindow(log_dir, steps=steps)
+    w.start()
+    return w
+
+
 @dataclass
 class StepTimer:
     """Rolling per-step latency collector.
@@ -59,6 +133,7 @@ class StepTimer:
 
     times: list = field(default_factory=list)
     lanes: list = field(default_factory=list)
+    synced: list = field(default_factory=list)
     _last: float = field(default_factory=time.perf_counter)
 
     def mark(self, value=None, sync: bool = False, lanes: int = 1):
@@ -69,19 +144,37 @@ class StepTimer:
         now = time.perf_counter()
         self.times.append(now - self._last)
         self.lanes.append(lanes)
+        self.synced.append(bool(sync and value is not None))
         self._last = now
 
     def stats(self) -> dict:
         if not self.times:
             return {}
         arr = np.asarray(self.times)
+        # Two populations, never mixed (StepSeries' two-books rule): a
+        # sync=True mark includes the device drain a dispatch-only mark
+        # doesn't, so pooling them let a handful of sparse synced
+        # samples contaminate the dispatch p95. Headline percentiles
+        # come from the dispatch-only marks; the synced samples get
+        # their own block below.
+        synced = np.asarray(self.synced, dtype=bool)
+        disp = arr[~synced]
+        pop = disp if disp.size else arr
         out = {
             "steps": len(arr),
-            "mean_s": float(arr.mean()),
-            "p50_s": float(np.percentile(arr, 50)),
-            "p95_s": float(np.percentile(arr, 95)),
+            "mean_s": float(pop.mean()),
+            "p50_s": float(np.percentile(pop, 50)),
+            "p95_s": float(np.percentile(pop, 95)),
             "total_s": float(arr.sum()),
         }
+        if synced.any() and disp.size:
+            dev = arr[synced]
+            out["device_sampled"] = {
+                "count": int(dev.size),
+                "mean_s": float(dev.mean()),
+                "p50_s": float(np.percentile(dev, 50)),
+                "p95_s": float(np.percentile(dev, 95)),
+            }
         lane_steps = int(sum(self.lanes))
         if lane_steps != len(arr):  # at least one stacked mark
             out["lane_steps"] = lane_steps

@@ -1,0 +1,274 @@
+"""Anomaly layer (ISSUE 4): deterministic straggler detection on
+synthetic step-time series (seeded, no sleeps), loss plateau /
+divergence-precursor watches, anomaly event ordering across a retry
+boundary, and profiler-capture rate limiting (never more than N
+windows per series)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from multidisttorch_tpu import telemetry
+from multidisttorch_tpu.data.datasets import synthetic_mnist
+from multidisttorch_tpu.faults.plan import CRASH, SLOW, FaultPlan, FaultSpec
+from multidisttorch_tpu.hpo.driver import TrialConfig, run_hpo
+from multidisttorch_tpu.hpo.supervision import RetryPolicy
+from multidisttorch_tpu.telemetry import anomaly as tele_anomaly
+from multidisttorch_tpu.telemetry.anomaly import (
+    AnomalyConfig,
+    AnomalyMonitor,
+    RollingRobustZ,
+)
+from multidisttorch_tpu.utils import profiling
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_off_after():
+    yield
+    telemetry.disable()
+
+
+# -- the detector itself (pure, synthetic, no sleeps) -------------------
+
+
+def test_rolling_robust_z_warmup_and_outlier():
+    det = RollingRobustZ(window=16, min_samples=8)
+    for _ in range(8):
+        assert det.observe(0.010) is None  # warm-up: no verdict
+    z, med = det.observe(0.010)
+    assert med == pytest.approx(0.010)
+    assert abs(z) < 1.0
+    z, med = det.observe(0.200)  # 20x the median
+    assert z > 100  # MAD floored at 5% of median -> z = 0.19/0.0005
+    # The outlier is admitted AFTER scoring: the median barely moves.
+    _z, med = det.observe(0.010)
+    assert med == pytest.approx(0.010)
+
+
+def test_straggler_detection_deterministic_series():
+    """Seeded synthetic series: jittery-but-sane steps never flag;
+    a single 10x step flags exactly once."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    telemetry.configure(None)  # in-memory bus + registry + monitor
+    tele_anomaly.configure(
+        AnomalyConfig(min_samples=8, z_threshold=6.0, min_ratio=2.0)
+    )
+    mon = telemetry.get_monitor()
+    base = 0.010
+    fired = []
+    for i in range(50):
+        dt = base * float(rng.uniform(0.9, 1.1))
+        rec = mon.observe_step("trial-0", dt, trial_id=0, step=i)
+        if rec is not None:
+            fired.append(rec)
+    assert fired == []  # sane jitter never flags
+    rec = mon.observe_step("trial-0", 10 * base, trial_id=0, step=50)
+    assert rec is not None
+    assert rec["ratio"] >= 9.0
+    kinds = [e.kind for e in telemetry.get_bus().recent()]
+    assert kinds.count(tele_anomaly.STRAGGLER) == 1
+    reg = telemetry.get_registry()
+    assert reg.counter("anomalies_total", kind="straggler").value == 1
+
+
+def test_straggler_cooldown_suppresses_floods():
+    telemetry.configure(None)
+    tele_anomaly.configure(
+        AnomalyConfig(min_samples=4, z_threshold=4.0, min_ratio=2.0,
+                      cooldown_marks=8)
+    )
+    mon = telemetry.get_monitor()
+    for i in range(6):
+        mon.observe_step("k", 0.01, step=i)
+    flagged = sum(
+        mon.observe_step("k", 0.5, step=10 + i) is not None
+        for i in range(6)
+    )
+    assert flagged == 1  # one slow PHASE = one anomaly, not six
+
+
+def test_loss_plateau_and_divergence_precursor():
+    telemetry.configure(None)
+    tele_anomaly.configure(
+        AnomalyConfig(plateau_epochs=3, plateau_rel_eps=1e-3,
+                      diverge_ratio=2.0, diverge_epochs=3)
+    )
+    mon = telemetry.get_monitor()
+    # Healthy descent: nothing fires.
+    for e, loss in enumerate([100.0, 90.0, 80.0, 70.0], 1):
+        assert mon.observe_loss(0, epoch=e, train_loss=loss) is None
+    # Flat-lining for plateau_epochs: plateau, exactly once.
+    assert mon.observe_loss(0, epoch=5, train_loss=70.0) is None
+    assert mon.observe_loss(0, epoch=6, train_loss=70.0) is None
+    assert mon.observe_loss(0, epoch=7, train_loss=70.0) == (
+        tele_anomaly.LOSS_PLATEAU
+    )
+    assert mon.observe_loss(0, epoch=8, train_loss=70.0) is None
+    # Blow past 2x best while still finite: precursor, exactly once.
+    assert mon.observe_loss(0, epoch=9, train_loss=200.0) == (
+        tele_anomaly.DIVERGENCE_PRECURSOR
+    )
+    assert mon.observe_loss(0, epoch=10, train_loss=400.0) is None
+    kinds = [e.kind for e in telemetry.get_bus().recent()]
+    assert kinds.count(tele_anomaly.LOSS_PLATEAU) == 1
+    assert kinds.count(tele_anomaly.DIVERGENCE_PRECURSOR) == 1
+    # Non-finite losses are the guards' business, not a precursor.
+    assert mon.observe_loss(1, epoch=1, train_loss=float("nan")) is None
+
+
+# -- profiler capture: bounded and rate-limited ------------------------
+
+
+class _FakeWindow:
+    instances = []
+
+    def __init__(self, log_dir, steps):
+        self.log_dir = log_dir
+        self.remaining = steps
+        self.active = True
+        _FakeWindow.instances.append(self)
+
+    def tick(self):
+        if self.active:
+            self.remaining -= 1
+            if self.remaining <= 0:
+                self.stop()
+
+    def stop(self):
+        self.active = False
+
+
+def _fake_factory(log_dir, *, steps):
+    return _FakeWindow(log_dir, steps)
+
+
+def test_capture_rate_limited_per_key(tmp_path):
+    """Never more than max_captures_per_key windows per series, no
+    matter how many anomalies fire."""
+    _FakeWindow.instances = []
+    telemetry.configure(None)
+    tele_anomaly.configure(
+        AnomalyConfig(
+            min_samples=4, z_threshold=4.0, min_ratio=2.0,
+            cooldown_marks=0, capture_dir=str(tmp_path),
+            capture_steps=2, max_captures_per_key=2,
+            capture_cooldown_s=0.0,
+        ),
+        window_factory=_fake_factory,
+    )
+    mon = telemetry.get_monitor()
+    for i in range(8):
+        mon.observe_step("trial-0", 0.01, step=i)
+    anomalies = 0
+    for i in range(20):
+        # Slow steps interleaved with fast ones so the window (tick'd
+        # by every observe) closes between anomalies.
+        if mon.observe_step("trial-0", 0.5, step=100 + i) is not None:
+            anomalies += 1
+        for j in range(4):
+            mon.observe_step("trial-0", 0.01, step=200 + 10 * i + j)
+    assert anomalies > 2  # plenty of anomalies...
+    assert mon.captures_started("trial-0") == 2  # ...capped captures
+    assert len(_FakeWindow.instances) == 2
+    # Every opened window was bounded and closed itself.
+    assert all(not w.active for w in _FakeWindow.instances)
+
+
+def test_single_active_window_process_wide(tmp_path):
+    _FakeWindow.instances = []
+    telemetry.configure(None)
+    tele_anomaly.configure(
+        AnomalyConfig(
+            min_samples=4, z_threshold=4.0, min_ratio=2.0,
+            cooldown_marks=0, capture_dir=str(tmp_path),
+            capture_steps=1000, max_captures_per_key=5,
+            capture_cooldown_s=0.0,
+        ),
+        window_factory=_fake_factory,
+    )
+    mon = telemetry.get_monitor()
+    for i in range(8):
+        mon.observe_step("a", 0.01, step=i)
+        mon.observe_step("b", 0.01, step=i)
+    assert mon.observe_step("a", 0.5) is not None  # opens a window
+    rec = mon.observe_step("b", 0.5)  # anomaly fires, but NO new window
+    assert rec is not None and "capture" not in rec
+    assert len(_FakeWindow.instances) == 1
+
+
+def test_profile_window_real_capture(tmp_path):
+    """The real jax.profiler window on CPU: starts, ticks, closes after
+    N steps, leaves trace files; a second concurrent start degrades
+    gracefully."""
+    d = str(tmp_path / "win")
+    w = profiling.profile_window(d, steps=3)
+    assert w.active, w.error
+    w2 = profiling.profile_window(str(tmp_path / "win2"), steps=3)
+    assert not w2.active and "active" in w2.error
+    f = jax.jit(lambda x: x * 2)
+    x = jnp.ones((8,))
+    for _ in range(3):
+        jax.block_until_ready(f(x))
+        w.tick()
+    assert not w.active  # self-closed after 3 ticks
+    found = [fn for _r, _d, files in os.walk(d) for fn in files]
+    assert found, "profiler window must leave a trace on disk"
+
+
+# -- ordering across a retry boundary (driver integration) --------------
+
+
+def test_anomaly_ordering_across_retry(tmp_path):
+    """A SLOW fault flags a straggler DURING attempt 1; the event lands
+    between that attempt's start and its retrying end, and the stream
+    stays monotone across the crash/retry boundary."""
+    tdir = str(tmp_path / "tele")
+    cfgs = [
+        TrialConfig(trial_id=i, epochs=3, batch_size=16, hidden_dim=16,
+                    latent_dim=4, seed=i, log_interval=10_000)
+        for i in range(2)
+    ]
+    data = synthetic_mnist(128, seed=0)  # 8 steps/epoch
+    plan = FaultPlan(specs=(
+        FaultSpec(SLOW, 0, step=12, delay_s=0.25),
+        FaultSpec(CRASH, 0, step=18),
+    ))
+    with telemetry.telemetry_run(tdir):
+        tele_anomaly.configure(
+            AnomalyConfig(min_samples=4, z_threshold=4.0, min_ratio=3.0)
+        )
+        results = run_hpo(
+            cfgs, data, None, num_groups=2,
+            out_dir=str(tmp_path / "out"),
+            save_images=False, verbose=False,
+            resilient=True,
+            retry=RetryPolicy(max_retries=2, backoff_base_s=0.01),
+            fault_plan=plan,
+        )
+    assert all(
+        r.status in ("completed", "resumed_complete") for r in results
+    )
+    events = telemetry.read_events(os.path.join(tdir, "events.jsonl"))
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)  # monotone across the retry boundary
+    seq = [
+        (e["kind"], (e.get("data") or {}).get("status"))
+        for e in events
+        if e.get("trial_id") == 0
+        and e["kind"] in ("attempt_start", "attempt_end",
+                          tele_anomaly.STRAGGLER)
+    ]
+    kinds = [k for k, _ in seq]
+    assert tele_anomaly.STRAGGLER in kinds
+    first_straggler = kinds.index(tele_anomaly.STRAGGLER)
+    # Straggler fired inside attempt 1: after its start, before the
+    # retrying end; and the completed end comes after everything.
+    assert first_straggler > kinds.index("attempt_start")
+    assert first_straggler < seq.index(("attempt_end", "retrying"))
+    assert seq.index(("attempt_end", "retrying")) < seq.index(
+        ("attempt_end", "completed")
+    )

@@ -74,6 +74,13 @@ def test_bench_lm_smoke(monkeypatch):
     assert r["train_flops_per_token"] == bench._lm_train_flops_per_token(
         d=32, layers=1, t=32, vocab=64
     )
+    # MFU cross-check (ISSUE 4 satellite): XLA's own cost analysis of
+    # the timed program rides next to the analytic estimate, with the
+    # >10% disagreement verdict — no more trust-me arithmetic.
+    agree = r["flops_agreement"]
+    assert agree["analytic"] == r["train_flops_per_token"]
+    assert agree["cost_analysis"] and agree["cost_analysis"] > 0
+    assert isinstance(agree["disagrees_over_10pct"], bool)
     import numpy as np
 
     assert np.isfinite(r["final_loss"])
@@ -103,6 +110,10 @@ def test_bench_ours_smoke(monkeypatch):
     assert r["samples_per_sec_per_chip"] > 0
     assert len(r["pass_samples_per_sec_per_chip"]) == r["passes"] == 2
     assert 0 < r["p10"] <= r["p90"]
+    # Cost-analysis cross-check of the flagship MFU numerator.
+    agree = r["flops_agreement"]
+    assert agree["analytic"] == bench._train_flops_per_sample()
+    assert agree["cost_analysis"] and agree["cost_analysis"] > 0
 
 
 def test_kernel_smoke_all_pass():

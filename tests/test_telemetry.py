@@ -18,6 +18,8 @@ from multidisttorch_tpu.data.datasets import synthetic_mnist
 from multidisttorch_tpu.faults.plan import CRASH, FaultPlan, FaultSpec
 from multidisttorch_tpu.hpo.driver import TrialConfig, run_hpo
 from multidisttorch_tpu.hpo.supervision import RetryPolicy
+from multidisttorch_tpu.telemetry import anomaly as tele_anomaly
+from multidisttorch_tpu.telemetry import device as tele_device
 from multidisttorch_tpu.telemetry import events as tele_events
 from multidisttorch_tpu.telemetry import export as tele_export
 from multidisttorch_tpu.telemetry import metrics as tele_metrics
@@ -268,13 +270,28 @@ class _Boom:
         )
 
 
+def _boom_fn(*a, **kw):
+    raise AssertionError(
+        "telemetry device/anomaly seam reached with telemetry OFF — the "
+        "zero-cost contract is broken"
+    )
+
+
 def test_telemetry_off_constructs_no_events(tmp_path, monkeypatch):
     assert telemetry.get_bus() is None and telemetry.get_registry() is None
+    assert telemetry.get_monitor() is None
     # Any Event construction anywhere in the sweep now explodes.
     monkeypatch.setattr(tele_events, "Event", _Boom)
     monkeypatch.setattr(
         tele_metrics, "StepSeries", _Boom
     )  # and no step series either
+    # ...and no device-book or anomaly objects either (ISSUE 4): the
+    # cost/memory/straggler seams must all sit behind the same guards.
+    monkeypatch.setattr(tele_device, "record_step_cost", _boom_fn)
+    monkeypatch.setattr(tele_device, "sample_memory", _boom_fn)
+    monkeypatch.setattr(tele_device, "compiled_cost_analysis", _boom_fn)
+    monkeypatch.setattr(tele_anomaly, "RollingRobustZ", _Boom)
+    monkeypatch.setattr(tele_anomaly, "AnomalyMonitor", _Boom)
     cfgs = small_configs(2, epochs=1)
     data = synthetic_mnist(64, seed=0)
     results = run_hpo(
@@ -304,6 +321,61 @@ def test_steptimer_stacked_attribution():
     t2.mark()
     t2.mark()
     assert "lane_steps" not in t2.stats()
+
+
+def test_steptimer_separates_sync_population():
+    """The p95 satellite of ISSUE 4: sparse sync=True marks (device-
+    inclusive, systematically longer) must not contaminate the
+    dispatch-only percentiles — the two populations report separately,
+    mirroring StepSeries' dispatch/device books."""
+    t = StepTimer()
+    # Hand-build the two populations (no sleeps): 20 fast dispatch
+    # marks and 2 slow synced ones.
+    t.times = [0.001] * 20 + [0.5, 0.6]
+    t.lanes = [1] * 22
+    t.synced = [False] * 20 + [True, True]
+    s = t.stats()
+    assert s["steps"] == 22
+    assert s["p95_s"] == pytest.approx(0.001)  # uncontaminated
+    assert s["mean_s"] == pytest.approx(0.001)
+    assert s["total_s"] == pytest.approx(20 * 0.001 + 1.1)
+    dev = s["device_sampled"]
+    assert dev["count"] == 2
+    assert dev["p50_s"] == pytest.approx(0.55)
+    # No sync marks -> exact legacy shape, no new keys.
+    t2 = StepTimer()
+    t2.times, t2.lanes, t2.synced = [0.001] * 3, [1] * 3, [False] * 3
+    assert "device_sampled" not in t2.stats()
+
+
+def test_step_series_open_interval():
+    """open_interval breaks the chain: the next mark opens instead of
+    closing a boundary-spanning interval (epoch boundaries must not
+    read as giant steps)."""
+    s = tele_metrics.StepSeries(sample_every=0)
+    s.mark()
+    assert s.mark() is not None  # normal chained mark observes
+    s.open_interval()
+    assert s.mark() is None  # re-opened: nothing observed
+    assert s.mark() is not None
+    assert s.dispatches == 2
+
+
+def test_step_series_synced_mark_returns_none():
+    """A device-synced sample's interval includes the drained dispatch
+    backlog — it must go to the device book but NOT be returned as a
+    dispatch dt (the straggler detector would false-fire on it every
+    sample_every marks and burn its capture budget)."""
+    import jax.numpy as jnp
+
+    v = jnp.zeros(())
+    s = tele_metrics.StepSeries(sample_every=1)  # every mark syncs
+    s.mark(v)  # opening
+    assert s.mark(v) is None
+    assert s.device.count == 1  # ...but the device book observed it
+    s2 = tele_metrics.StepSeries(sample_every=0)  # never syncs
+    s2.mark(v)
+    assert s2.mark(v) is not None  # dispatch marks still feed the det.
 
 
 def test_step_series_per_lane_rate():
@@ -392,6 +464,41 @@ def test_ledger_view_settled_vs_in_flight(tmp_path, capsys):
 def test_sweep_top_missing_file_errors(tmp_path, capsys):
     sweep_top = _load_tool("sweep_top")
     assert sweep_top.main([str(tmp_path / "nope")]) == 1
+
+
+def test_sweep_top_json_snapshot(tmp_path, capsys):
+    """--json: machine-readable one-shot of the same fold (ISSUE 4
+    satellite) — CI consumes this instead of screen-scraping."""
+    tdir, _paths = _demo_events(tmp_path)
+    capsys.readouterr()  # drain the demo sweep's own log lines
+    sweep_top = _load_tool("sweep_top")
+    assert sweep_top.main([tdir, "--json"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["done"] is True
+    assert snap["goodput"] is not None
+    t0 = snap["trials"]["0"]
+    assert t0["attempts"] == 2 and t0["status"] == "completed"
+    # Device books folded off the event stream: cost record + memory
+    # watermark per series key.
+    assert snap["device_books"]
+    book = next(iter(snap["device_books"].values()))
+    assert book.get("flops_per_lane_step") or book.get("peak_bytes")
+
+
+def test_ledger_view_json_snapshot(tmp_path, capsys):
+    from multidisttorch_tpu.hpo.ledger import SweepLedger
+
+    out_dir = str(tmp_path / "sweep")
+    led = SweepLedger(out_dir)
+    led.attempt_start(0, "aaaa", 1)
+    led.attempt_end(0, "aaaa", 1, "completed", summary={"steps": 8})
+    led.attempt_start(1, "bbbb", 1)
+    ledger_view = _load_tool("ledger_view")
+    assert ledger_view.main([out_dir, "--json"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert snap["configs"] == 2
+    assert snap["settled"] == 1 and snap["in_flight"] == 1
+    assert snap["by_config"]["aaaa"]["attempts"][0]["status"] == "completed"
 
 
 # -- chaos harness telemetry block (trace acceptance) ------------------

@@ -123,6 +123,10 @@ def main() -> int:
         # tagged event in a monotonic, Perfetto-loadable trace
         and tel.get("all_faults_traced", False)
         and tel.get("trace_monotonic", False)
+        # the device-books acceptance (ISSUE 4): the exported summary
+        # carries per-trial MFU (or explicit null-with-reason) and
+        # peak-memory fields
+        and tel.get("device_books_in_summary", False)
     )
     headline = {
         "metric": "chaos_goodput_useful_over_executed_steps",
@@ -134,6 +138,9 @@ def main() -> int:
         "restarts_after_preemption": report["restarts_after_preemption"],
         "telemetry_trace": tel.get("trace"),
         "all_faults_traced": tel.get("all_faults_traced"),
+        "device_books_in_summary": tel.get("device_books_in_summary"),
+        "anomalies_traced": tel.get("anomalies_traced"),
+        "profiler_captures": tel.get("profiler_captures"),
         "detail": report,
     }
     print(json.dumps(headline))

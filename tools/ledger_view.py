@@ -131,16 +131,43 @@ def main(argv=None) -> int:
         "path",
         help="sweep out-dir (containing sweep_ledger.jsonl) or the file",
     )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="machine-readable snapshot of the same fold (per-config "
+        "attempt history, settled/in-flight) instead of the rendered "
+        "table — for CI and scripts",
+    )
     args = parser.parse_args(argv)
     path = resolve_ledger_path(args.path)
     if not os.path.exists(path):
         print(f"no ledger at {path}", file=sys.stderr)
         return 1
     events = load_ledger(path)
+    folded = fold(events)
+    if args.json:
+        import json
+
+        print(
+            json.dumps(
+                {
+                    "path": path,
+                    "configs": len(folded),
+                    "settled": sum(
+                        1 for r in folded.values() if r["settled"]
+                    ),
+                    "in_flight": sum(
+                        1 for r in folded.values() if r["in_flight"]
+                    ),
+                    "by_config": folded,
+                },
+                default=str,
+            )
+        )
+        return 0
     if not events:
         print(f"ledger at {path} holds no decodable events")
         return 0
-    print(render(fold(events), path))
+    print(render(folded, path))
     return 0
 
 

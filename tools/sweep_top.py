@@ -28,7 +28,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from multidisttorch_tpu.telemetry.console import (  # noqa: E402
     clear_screen,
+    fmt_bytes,
     fmt_duration,
+    fmt_mfu,
     fmt_rate,
     fmt_table,
     fmt_ts,
@@ -42,6 +44,42 @@ def resolve_events_path(path: str) -> str:
     if os.path.isdir(path):
         return os.path.join(path, EVENTS_NAME)
     return path
+
+
+def live_mfu(state: SweepFold, tid: int, rate) -> "float | None":
+    """Best-effort live MFU for one trial: its device_cost book's
+    per-lane-step FLOPs x its own step rate over the submesh peak.
+    None off-TPU (no peak) or before the cost book lands."""
+    key = state.series_key_of(tid)
+    book = state.device.get(key) if key else None
+    if not book or not rate:
+        return None
+    flops = book.get("flops_per_lane_step")
+    peak = book.get("peak_flops_per_chip")
+    ndev = book.get("devices") or 1
+    if not flops or not peak:
+        return None
+    return flops * rate / (peak * ndev)
+
+
+def snapshot(state: SweepFold, path: str) -> dict:
+    """Machine-readable one-shot fold of the event stream — the same
+    accounting the rendered console shows, JSON-shaped so CI and
+    scripts can consume it without screen-scraping (``--json``)."""
+    return {
+        "path": path,
+        "events": state.events,
+        "first_ts": state.first_ts,
+        "last_ts": state.last_ts,
+        "sweep": state.sweep,
+        "done": state.done,
+        "useful_steps": state.useful,
+        "executed_steps": state.executed,
+        "goodput": state.goodput,
+        "anomalies": state.anomalies,
+        "trials": {k: state.trials[k] for k in sorted(state.trials)},
+        "device_books": {k: state.device[k] for k in sorted(state.device)},
+    }
 
 
 def render(state: SweepFold, path: str) -> str:
@@ -83,6 +121,8 @@ def render(state: SweepFold, path: str) -> str:
             else None
         )
         rate = t["step"] / wall if wall and t["step"] else None
+        key = state.series_key_of(tid)
+        book = state.device.get(key, {}) if key else {}
         rows.append(
             [
                 tid,
@@ -98,6 +138,9 @@ def render(state: SweepFold, path: str) -> str:
                 t["retries"],
                 t["faults"],
                 t["lane"] if t["lane"] is not None else "-",
+                fmt_mfu(live_mfu(state, tid, rate)),
+                fmt_bytes(book.get("peak_bytes")),
+                t.get("anomalies", 0) or "-",
                 fmt_duration(wall),
             ]
         )
@@ -106,7 +149,7 @@ def render(state: SweepFold, path: str) -> str:
             rows,
             ["trial", "status", "att", "epoch", "steps", "step rate",
              "train loss", "test loss", "retries", "faults", "lane",
-             "wall"],
+             "mfu", "peak mem", "anom", "wall"],
         )
     )
     return "\n".join(lines)
@@ -153,6 +196,12 @@ def main(argv=None) -> int:
         "-f", "--follow", action="store_true",
         help="keep tailing and redraw every --interval seconds",
     )
+    parser.add_argument(
+        "--json", action="store_true",
+        help="one-shot machine-readable snapshot of the fold (trials, "
+        "goodput, device books) instead of the rendered console — for "
+        "CI and scripts; mutually exclusive with --follow",
+    )
     parser.add_argument("--interval", type=float, default=1.0)
     parser.add_argument(
         "--max-refreshes", type=int, default=0,
@@ -160,6 +209,8 @@ def main(argv=None) -> int:
         "mostly for tests)",
     )
     args = parser.parse_args(argv)
+    if args.json and args.follow:
+        parser.error("--json is one-shot; it cannot combine with --follow")
 
     path = resolve_events_path(args.path)
     if not os.path.exists(path) and not args.follow:
@@ -167,6 +218,9 @@ def main(argv=None) -> int:
         return 1
     state = SweepFold()
     offset = follow_lines(path, state, 0)
+    if args.json:
+        print(json.dumps(snapshot(state, path), default=str))
+        return 0
     if not args.follow:
         print(render(state, path))
         return 0
