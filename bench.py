@@ -1656,6 +1656,14 @@ def main():
         "recovered trials vs the fault-free sweep",
     )
     parser.add_argument(
+        "--chaos-mh", action="store_true",
+        help="run the ELASTIC multi-host chaos drill (CPU, 3 virtual "
+        "hosts under tools/sweep_supervisor.py): kill one host "
+        "mid-sweep, supervised world-shrink restart, ledger-driven "
+        "trial migration, goodput + bit-parity of recovered trials "
+        "(docs/RESILIENCE.md \"Elastic multi-host\")",
+    )
+    parser.add_argument(
         "--suite", action="store_true",
         help="bank every measurement (flagship, fused-loss comparison, "
         "LM, to-elbo, loader) in one process — for one-shot windows on "
@@ -1666,11 +1674,12 @@ def main():
     if sum(x is not None and x is not False
            for x in (args.concurrency, args.to_elbo, args.loader,
                      args.lm, args.suite, args.decode, args.stacked,
-                     args.chaos)) > 1:
+                     args.chaos, args.chaos_mh)) > 1:
         parser.error("--concurrency/--to-elbo/--loader/--lm/--decode/"
-                     "--suite/--stacked/--chaos are mutually exclusive")
+                     "--suite/--stacked/--chaos/--chaos-mh are mutually "
+                     "exclusive")
 
-    if (args.stacked or args.chaos) and \
+    if (args.stacked or args.chaos or args.chaos_mh) and \
             "xla_force_host_platform_device_count" not in (
         os.environ.get("XLA_FLAGS", "")
     ):
@@ -1854,6 +1863,32 @@ def main():
                     ],
                     "telemetry_trace": tel.get("trace"),
                     "all_faults_traced": tel.get("all_faults_traced"),
+                    "detail": r,
+                }
+            )
+        )
+        return
+
+    if args.chaos_mh:
+        import tempfile
+
+        from multidisttorch_tpu.faults.harness import run_chaos_mh_bench
+
+        r = run_chaos_mh_bench(tempfile.mkdtemp(prefix="bench_chaos_mh_"))
+        r["backend"] = backend
+        print(
+            json.dumps(
+                {
+                    "metric": "chaos_mh_goodput_useful_over_executed_steps",
+                    "value": r["goodput"],
+                    "unit": "fraction",
+                    # acceptance floor: goodput >= 0.8 with 1-of-3
+                    # hosts killed mid-sweep and the world re-formed
+                    "vs_baseline": round(r["goodput"] / 0.8, 3),
+                    "all_trials_settled": r["all_trials_settled"],
+                    "recovered_bit_identical": r["recovered_bit_identical"],
+                    "worlds_formed": r["worlds_formed"],
+                    "hosts_lost": r["hosts_lost"],
                     "detail": r,
                 }
             )

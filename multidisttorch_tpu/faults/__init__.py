@@ -15,13 +15,17 @@ from multidisttorch_tpu.faults.plan import (  # noqa: F401
     CRASH,
     DATA_ERROR,
     DIVERGE,
+    HOST_KINDS,
+    HOST_LOST,
     INFRA_KINDS,
     PREEMPT,
     SLOW,
+    WEDGE,
     FaultPlan,
     FaultSpec,
 )
 from multidisttorch_tpu.faults.inject import (  # noqa: F401
+    HOST_LOST_EXIT_CODE,
     DataFault,
     FaultInjector,
     HostPreemption,

@@ -336,6 +336,311 @@ def _export_telemetry(tel_dir: str, injector: FaultInjector) -> dict:
     }
 
 
+def run_chaos_mh_bench(
+    work_dir: str,
+    *,
+    hosts: int = 3,
+    devs_per_host: int = 2,
+    trials: int = 6,
+    epochs: int = 3,
+    kind: str = "host_lost",
+    victim: int = 1,
+    fault_at_host_step: "int | None" = None,
+    groups_mode: str = "per_host",
+    data_rows: int = 128,
+    heartbeat_deadline_s: float = 3.0,
+    agree_timeout_s: float = 15.0,
+    world_timeout_s: float = 420.0,
+    boot_grace_s: float = 120.0,
+) -> dict:
+    """The elastic multi-host chaos drill behind ``bench.py --chaos-mh``
+    and ``tools/chaos_run.py --multihost`` (docs/RESILIENCE.md
+    "Elastic multi-host").
+
+    Kill-one-of-N on CPU: an :class:`~tools.sweep_supervisor.
+    ElasticSupervisor` launches ``hosts`` worker processes (the
+    framework's own OpenMPI-style detection, ``devs_per_host`` virtual
+    CPU devices each, one submesh group per host), a host-scoped fault
+    fires on host ``victim`` mid-sweep (``host_lost``: instant
+    ``os._exit``, SIGKILL semantics; ``wedge``: the host stalls with
+    its heartbeat suspended and the survivors' sync watchdogs must
+    exit with a named ``WedgedCollective`` within the deadline), and
+    the supervisor re-forms a ``hosts - 1`` world that finishes the
+    sweep against the ledger.
+
+    Reported acceptance inputs:
+
+    - **completion**: every trial settles (the survivors absorb the
+      victim's trials — ledger-driven migration);
+    - **goodput**: useful/executed optimizer steps across all worlds
+      and attempts (the single-host chaos bench's step-based metric);
+    - **parity**: recovered trials' final losses are bit-identical to
+      an in-process fault-free reference — legitimate here because the
+      submesh SHAPE survives the shrink (every group is
+      ``devs_per_host`` devices before and after), so per-trial math
+      is invariant to which host runs it;
+    - **watchdog**: for ``kind="wedge"``, at least one survivor exited
+      with ``PREEMPTION_EXIT_CODE`` naming ``WedgedCollective``.
+    """
+    import json
+    import os
+    import shutil
+    import sys
+
+    from multidisttorch_tpu.faults.plan import FaultSpec, HOST_KINDS
+    from multidisttorch_tpu.hpo.driver import run_hpo
+    from multidisttorch_tpu.hpo.ledger import SweepLedger
+    from multidisttorch_tpu.parallel.membership import world_history
+    from multidisttorch_tpu.parallel.mesh import setup_groups
+
+    if kind not in HOST_KINDS:
+        raise ValueError(f"kind must be one of {sorted(HOST_KINDS)}")
+
+    configs = standard_configs(trials, epochs)
+    steps_per_epoch = data_rows // configs[0].batch_size
+    if fault_at_host_step is None:
+        # Mid-sweep on the victim's cumulative-step clock: past the
+        # first epoch boundary (so a checkpoint exists to migrate
+        # from), well before its share of the sweep completes.
+        fault_at_host_step = steps_per_epoch + steps_per_epoch // 2
+
+    run_dir = os.path.join(work_dir, "mh_chaos")
+    ff_dir = os.path.join(work_dir, "mh_fault_free")
+    for d in (run_dir, ff_dir):
+        shutil.rmtree(d, ignore_errors=True)
+    os.makedirs(run_dir, exist_ok=True)
+
+    # --- fault-free reference (in-process, same submesh shape) ------
+    # Bit-parity is the contract only where the submesh SHAPE survives
+    # the shrink: per-host groups keep every group devs_per_host wide
+    # in every world. A spanning-group drill (groups_mode="1", the
+    # wedge-watchdog exercise) changes the group width on shrink, so
+    # the reduction order — and hence the bits — legitimately differ;
+    # parity is skipped there, completion + watchdog are the gates.
+    wall_ff = 0.0
+    ff_loss: dict = {}
+    parity_applicable = groups_mode == "per_host"
+    if parity_applicable:
+        from multidisttorch_tpu.data.datasets import synthetic_mnist
+
+        import jax
+
+        n_dev = hosts * devs_per_host
+        if len(jax.devices()) < n_dev:
+            raise RuntimeError(
+                f"chaos-mh reference needs {n_dev} local virtual devices, "
+                f"found {len(jax.devices())} (set "
+                "--xla_force_host_platform_device_count)"
+            )
+        train = synthetic_mnist(data_rows, seed=0)
+        t0 = time.time()
+        ff_results = run_hpo(
+            configs,
+            train,
+            None,
+            groups=setup_groups(hosts, devices=jax.devices()[:n_dev]),
+            out_dir=ff_dir,
+            verbose=False,
+            save_images=False,
+            save_checkpoints=False,
+            ledger=False,
+        )
+        wall_ff = time.time() - t0
+        ff_loss = {r.trial_id: r.final_train_loss for r in ff_results}
+
+    # --- the drill --------------------------------------------------
+    from multidisttorch_tpu.faults.plan import FaultPlan
+
+    plan = FaultPlan(
+        specs=(
+            FaultSpec(
+                kind,
+                trial_id=-1,
+                step=int(fault_at_host_step),
+                host=int(victim),
+                delay_s=600.0 if kind == "wedge" else 0.0,
+            ),
+        ),
+        seed=0,
+    )
+    with open(os.path.join(run_dir, "fault_plan.json"), "w") as f:
+        f.write(plan.to_json())
+
+    # tools/ is not a package: resolve the supervisor/worker by path.
+    tools_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        "tools",
+    )
+    sys.path.insert(0, tools_dir)
+    try:
+        from sweep_supervisor import ElasticSupervisor
+    finally:
+        sys.path.remove(tools_dir)
+
+    from multidisttorch_tpu import telemetry
+
+    t0 = time.time()
+    with telemetry.telemetry_run(os.path.join(run_dir, "telemetry", "sup")):
+        sup = ElasticSupervisor(
+            [
+                sys.executable,
+                os.path.join(tools_dir, "elastic_worker.py"),
+                "chaos_sweep",
+                run_dir,
+            ],
+            run_dir,
+            hosts,
+            devs_per_host=devs_per_host,
+            heartbeat_deadline_s=heartbeat_deadline_s,
+            boot_grace_s=boot_grace_s,
+            world_timeout_s=world_timeout_s,
+            env_extra={
+                "MDT_MH_TRIALS": str(trials),
+                "MDT_MH_EPOCHS": str(epochs),
+                "MDT_MH_DATA_ROWS": str(data_rows),
+                "MDT_MH_GROUPS": groups_mode,
+                "MDT_AGREE_TIMEOUT_S": str(agree_timeout_s),
+                "MDT_SYNC_TIMEOUT_S": str(agree_timeout_s),
+            },
+        )
+        sup_report = sup.run()
+    wall_chaos = time.time() - t0
+
+    # --- gather the final world's results ---------------------------
+    final = sup_report["worlds"][-1]
+    merged: dict[int, dict] = {}
+    for slot in final["hosts"]:
+        path = os.path.join(
+            run_dir, f"results-h{slot}-w{final['epoch']}.json"
+        )
+        if not os.path.exists(path):
+            continue
+        with open(path) as f:
+            rec = json.load(f)
+        for tid_s, t in rec.get("trials", {}).items():
+            tid = int(tid_s)
+            cur = merged.get(tid)
+            # Prefer the owner's live record over peers' ledger echoes.
+            if cur is None or (
+                t["status"] == "completed"
+                and cur["status"] != "completed"
+            ):
+                merged[tid] = t
+
+    settled = {"completed", "resumed_complete", "diverged"}
+    useful_steps = sum(
+        t["steps"] for t in merged.values() if t["status"] in settled
+    )
+    # Executed = every step embodied in a settled outcome (including
+    # the checkpointed prefix a since-lost host executed — that work
+    # happened exactly once, even when its records died with the host)
+    # + the recorded progress of failed/preempted/retried attempts
+    # beyond their own resume points — including wasted-step totals the
+    # supervisor's between-worlds ledger compaction carried into its
+    # `compacted` summary records. Work a hard-killed host did PAST its
+    # last checkpoint is unobservable and uncounted, so goodput is an
+    # upper bound — and <= 1 by construction (executed >= useful).
+    from multidisttorch_tpu.hpo.ledger import wasted_steps
+
+    executed_steps = useful_steps + sum(
+        wasted_steps(ev) for ev in SweepLedger(run_dir).load()
+    )
+    goodput = useful_steps / executed_steps if executed_steps else 0.0
+
+    parity = []
+    for cfg in (configs if parity_applicable else []):
+        t = merged.get(cfg.trial_id)
+        if t is None or t["status"] not in ("completed", "resumed_complete"):
+            continue
+        parity.append(
+            {
+                "trial_id": cfg.trial_id,
+                "chaos_loss": t["final_train_loss"],
+                "fault_free_loss": ff_loss[cfg.trial_id],
+                "bit_identical": (
+                    t["final_train_loss"] == ff_loss[cfg.trial_id]
+                ),
+            }
+        )
+
+    # Watchdog evidence: survivors of a wedged world exit 75 printing
+    # the named error; grep the world logs.
+    wedged_exits = 0
+    for w in sup_report["worlds"]:
+        for slot, log in (w.get("logs") or {}).items():
+            try:
+                with open(log) as f:
+                    text = f.read()
+            except OSError:
+                continue
+            if "WedgedCollective" in text:
+                wedged_exits += 1
+
+    # Membership telemetry union: every world's worker sinks plus the
+    # supervisor's, folded for the traced-events cross-check.
+    from multidisttorch_tpu.telemetry.events import read_events
+
+    tel_root = os.path.join(run_dir, "telemetry")
+    tel_events = []
+    for dirpath, _dirs, names in os.walk(tel_root):
+        for name in names:
+            if name.endswith(".jsonl"):
+                tel_events.extend(read_events(os.path.join(dirpath, name)))
+    kinds = {}
+    for ev in tel_events:
+        k = str(ev.get("kind", ""))
+        kinds[k] = kinds.get(k, 0) + 1
+
+    all_settled = all(
+        merged.get(cfg.trial_id, {}).get("status") in settled
+        for cfg in configs
+    )
+    return {
+        "protocol": "chaos_mh_v1",
+        "kind": kind,
+        "hosts": hosts,
+        "devs_per_host": devs_per_host,
+        "victim": victim,
+        "fault_at_host_step": int(fault_at_host_step),
+        "trials": trials,
+        "epochs": epochs,
+        "steps_per_epoch": steps_per_epoch,
+        "plan": json.loads(plan.to_json()),
+        "worlds_formed": sup_report["worlds_formed"],
+        "hosts_lost": sup_report["hosts_lost"],
+        "hosts_final": sup_report["hosts_final"],
+        "all_trials_settled": all_settled,
+        "statuses": {
+            tid: t["status"] for tid, t in sorted(merged.items())
+        },
+        "useful_steps": useful_steps,
+        "executed_steps": executed_steps,
+        "goodput": round(goodput, 4),
+        "groups_mode": groups_mode,
+        "parity_applicable": parity_applicable,
+        "parity": parity,
+        "recovered_bit_identical": (
+            all(p["bit_identical"] for p in parity) and bool(parity)
+            if parity_applicable
+            else None
+        ),
+        "wedged_collective_exits": wedged_exits,
+        "wall_fault_free_s": round(wall_ff, 3),
+        "wall_chaos_s": round(wall_chaos, 3),
+        "membership": {
+            "worlds": world_history(run_dir),
+            "events_traced": kinds,
+            "host_lost_traced": kinds.get("host_lost", 0) > 0,
+            "world_shrunk_traced": kinds.get("world_shrunk", 0) > 0,
+            "trials_migrated_traced": kinds.get("trial_migrated", 0),
+        },
+        "supervisor": sup_report,
+        "run_dir": run_dir,
+    }
+
+
 def _executed_steps(ledger, useful) -> int:
     """Total optimizer steps executed across every attempt: each
     attempt's (end step − resume step), summed — settled final attempts
@@ -344,20 +649,13 @@ def _executed_steps(ledger, useful) -> int:
     the result-side sum (their final attempt's work arrives via the
     'failed' event's progress summary; counting the result too would
     double-count it, and its steps are wasted work, not useful)."""
+    from multidisttorch_tpu.hpo.ledger import wasted_steps
+
     total = sum(
         max(0, r.steps - r.resumed_from_step)
         for r in useful
         if r.status in ("completed", "resumed_complete", "diverged")
     )
-    for ev in ledger.load():
-        if ev.get("event") != "attempt_end":
-            continue
-        if ev.get("status") not in ("retrying", "preempted", "failed"):
-            continue
-        s = ev.get("summary") or {}
-        total += max(
-            0,
-            int(s.get("steps_at_failure", 0))
-            - int(s.get("resumed_from_step", 0)),
-        )
-    return total
+    # wasted_steps also honors `compacted` summaries, so the accounting
+    # survives a ledger compaction between restarts.
+    return total + sum(wasted_steps(ev) for ev in ledger.load())

@@ -24,6 +24,7 @@ Hook sites (threaded by ``hpo/driver.py``):
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from typing import Optional
@@ -35,11 +36,20 @@ from multidisttorch_tpu.faults.plan import (
     CRASH,
     DATA_ERROR,
     DIVERGE,
+    HOST_KINDS,
+    HOST_LOST,
     PREEMPT,
     SLOW,
+    WEDGE,
     FaultPlan,
     FaultSpec,
 )
+
+# Exit code of a simulated hard host loss (os._exit — no cleanup, no
+# atexit, heartbeat dies mid-lease, exactly like SIGKILL/slice loss).
+# Deliberately NOT cluster.PREEMPTION_EXIT_CODE: a lost host must read
+# as LOST to the supervisor, not as a healthy preempted worker.
+HOST_LOST_EXIT_CODE = 86
 
 
 class InfraFault(RuntimeError):
@@ -70,12 +80,44 @@ class FaultInjector:
     modeling a transient fault.
     """
 
-    def __init__(self, plan: FaultPlan):
+    def __init__(
+        self,
+        plan: FaultPlan,
+        *,
+        host_slot: "Optional[int]" = None,
+        fired_log: "Optional[str]" = None,
+    ):
         import threading
 
         self.plan = plan
         self._fires: dict[int, int] = {}  # spec index -> times fired
         self.fired: list[dict] = []  # chronological record, for reports
+        # Host-scoped faults (plan.HOST_KINDS): this process's stable
+        # host slot in a multi-host world (None = single-controller, no
+        # host faults ever fire) and its cumulative dispatched-step
+        # counter across ALL trials — the firing clock for host kinds.
+        self.host_slot = host_slot
+        self._host_steps = 0
+        # Durable fired state for elastic restarts: an in-memory
+        # injector dies with its host, but a one-shot fault must stay
+        # one-shot when the supervisor relaunches the world. Every
+        # _record appends (fsync'd — a host_lost os._exit follows
+        # immediately) to this JSONL; on construction prior fires are
+        # replayed into the dueness bookkeeping.
+        self._fired_log = fired_log
+        if fired_log is not None and os.path.exists(fired_log):
+            with open(fired_log) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail from a dying host
+                    idx = int(rec.get("spec_index", -1))
+                    if idx >= 0:
+                        self._fires[idx] = self._fires.get(idx, 0) + 1
         # The driver's scheduling loop is single-threaded, but the
         # checkpoint hook fires from the background writer thread —
         # bookkeeping mutations take this lock.
@@ -93,6 +135,22 @@ class FaultInjector:
                 {"kind": spec.kind, "trial_id": spec.trial_id, **ctx,
                  "ts": time.time()}
             )
+            if self._fired_log is not None:
+                os.makedirs(
+                    os.path.dirname(self._fired_log) or ".", exist_ok=True
+                )
+                with open(self._fired_log, "a") as f:
+                    f.write(
+                        json.dumps(
+                            {"spec_index": spec_index, "kind": spec.kind,
+                             "trial_id": spec.trial_id, **ctx,
+                             "ts": time.time()},
+                            default=str,
+                        )
+                        + "\n"
+                    )
+                    f.flush()
+                    os.fsync(f.fileno())
         # Telemetry seam: every fired fault tags itself into the event
         # stream, so a chaos run's trace self-documents its injections
         # next to the recovery they triggered.
@@ -140,11 +198,45 @@ class FaultInjector:
     # All `fired` records carry step=spec.step — the fault's scheduled
     # point, not the dispatch-window start — so reports read uniformly.
 
+    def _host_hook(self, n_steps: int) -> None:
+        """Fire host-scoped faults (HOST_KINDS) keyed to this host's
+        cumulative dispatched-step clock. HOST_LOST dies instantly
+        (``os._exit`` — SIGKILL semantics, heartbeat included); WEDGE
+        suspends the heartbeat and stalls, so the lease goes stale and
+        peers' sync watchdogs trip — if the stall ever ends (a finite
+        ``delay_s``), the host treats itself as preempted: the world
+        moved on without it."""
+        if self.host_slot is None:
+            return
+        window_end = self._host_steps + n_steps
+        self._host_steps = window_end
+        for idx, spec in enumerate(self.plan.specs):
+            if spec.kind not in HOST_KINDS or spec.host != self.host_slot:
+                continue
+            if not self._due(idx, spec) or spec.step >= window_end:
+                continue
+            self._record(idx, spec, step=spec.step, host=self.host_slot)
+            if spec.kind == HOST_LOST:
+                os._exit(HOST_LOST_EXIT_CODE)
+                return  # unreachable live; tests monkeypatch os._exit
+            assert spec.kind == WEDGE
+            from multidisttorch_tpu.parallel import membership
+
+            membership.suspend_heartbeat()
+            time.sleep(spec.delay_s if spec.delay_s > 0 else 3600.0)
+            raise HostPreemption(
+                f"injected wedge on host {self.host_slot} unwedged after "
+                f"{spec.delay_s:g}s — world presumed re-formed without it"
+            )
+
     def step_hook(self, trial_id: int, step: int, n_steps: int = 1) -> None:
         """Called before dispatching ``n_steps`` optimizer steps starting
         at ``step`` for ``trial_id``. Raises for CRASH/PREEMPT whose
         step falls in the window; sleeps for SLOW (and keeps scanning —
-        a straggler stall does not shadow a crash in the same window)."""
+        a straggler stall does not shadow a crash in the same window).
+        Host-scoped faults (HOST_LOST/WEDGE) ride the same seam on
+        their own cumulative-step clock."""
+        self._host_hook(n_steps)
         while True:
             m = self._match(
                 (CRASH, PREEMPT, SLOW), trial_id, step=step, n_steps=n_steps

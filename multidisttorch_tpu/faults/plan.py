@@ -29,9 +29,20 @@ DIVERGE = "diverge"        # poison the step's batch with NaN: the loss
                            # compiled program (terminal, not infra)
 CKPT_CORRUPT = "ckpt_corrupt"  # garble the trial's checkpoint file
                                # after the epoch write lands
+HOST_LOST = "host_lost"    # the targeted HOST dies instantly (os._exit,
+                           # no cleanup, heartbeat stops) — the elastic
+                           # supervisor must re-form the world without it
+WEDGE = "wedge"            # the targeted HOST stops making progress
+                           # (sleeps with its heartbeat suspended): the
+                           # peers' sync watchdogs must convert the
+                           # stuck collective into WedgedCollective
 
 INFRA_KINDS = frozenset({CRASH, PREEMPT, SLOW, DATA_ERROR, CKPT_CORRUPT})
-ALL_KINDS = INFRA_KINDS | {DIVERGE}
+# Host-scoped kinds fire on ONE host of a multi-host world (FaultSpec
+# .host), keyed to the host's cumulative dispatched-step count instead
+# of a single trial's step — the fault is about the host, not a trial.
+HOST_KINDS = frozenset({HOST_LOST, WEDGE})
+ALL_KINDS = INFRA_KINDS | HOST_KINDS | {DIVERGE}
 
 
 @dataclass(frozen=True)
@@ -39,10 +50,17 @@ class FaultSpec:
     """One injected fault: ``kind`` fires for ``trial_id`` at optimizer
     step ``step`` (step-scoped kinds) or at the epoch-``epoch``
     checkpoint write (``ckpt_corrupt``). ``delay_s`` is the SLOW kind's
-    stall. ``max_fires`` bounds repetition: the default 1 makes a fault
-    one-shot, so a retried trial sails past the injection point — the
-    shape of a transient infra fault (a permanent fault is just
-    ``max_fires`` >= the retry budget)."""
+    stall (and the WEDGE kind's stuck duration — 0 means "wedge until
+    killed"). ``max_fires`` bounds repetition: the default 1 makes a
+    fault one-shot, so a retried trial sails past the injection point —
+    the shape of a transient infra fault (a permanent fault is just
+    ``max_fires`` >= the retry budget).
+
+    Host-scoped kinds (:data:`HOST_KINDS`) target host slot ``host`` of
+    a multi-host world and fire when that host's CUMULATIVE dispatched
+    steps (any trial) reach ``step`` — ``trial_id`` is ignored (use -1).
+    Only a ``FaultInjector`` armed with a ``host_slot`` interprets them;
+    a single-controller run skips them entirely."""
 
     kind: str
     trial_id: int
@@ -50,6 +68,7 @@ class FaultSpec:
     epoch: int = -1
     delay_s: float = 0.0
     max_fires: int = 1
+    host: int = -1
 
     def __post_init__(self):
         if self.kind not in ALL_KINDS:
@@ -67,6 +86,11 @@ class FaultSpec:
             raise ValueError(
                 f"{self.kind} faults fire at an optimizer step; need "
                 f"step >= 0, got {self.step}"
+            )
+        if self.kind in HOST_KINDS and self.host < 0:
+            raise ValueError(
+                f"{self.kind} faults target a host slot; need host >= 0, "
+                f"got {self.host}"
             )
         if self.max_fires < 1:
             raise ValueError(f"max_fires must be >= 1, got {self.max_fires}")

@@ -256,8 +256,10 @@ class _TrialRun:
         resume=False,  # False | True (strict) | "scan" (supervised)
         agree_failures: bool = False,
         agree_timeout_s: Optional[float] = None,
+        wedge_timeout_s: Optional[float] = None,
         injector=None,  # faults.inject.FaultInjector | None
         ckpt_keep_last: int = 1,
+        attempt: int = 1,
     ):
         if cfg.fused_steps < 1:
             raise ValueError(
@@ -299,6 +301,18 @@ class _TrialRun:
         # while peers keep stepping it.
         self._agree = agree_failures
         self._agree_timeout_s = agree_timeout_s
+        # Wedge watchdog deadline for device-result fetches whose value
+        # transits a cross-host collective (epoch/test loss, checkpoint
+        # gather, completion drain): on a spanning submesh a peer that
+        # stopped dispatching leaves these blocked forever — the
+        # watchdog turns that into a named WedgedCollective within the
+        # deadline (classified as preemption; exit-code contract in
+        # docs/RESILIENCE.md). None/0 = unbounded; single-process and
+        # non-spanning trials never pay the watchdog thread.
+        self._wedge_timeout_s = wedge_timeout_s
+        # This run's attempt number (1-based, ledger-monotonic): scopes
+        # the cross-host restore agreement's sideband keys.
+        self._attempt = attempt
         self._deferred_error: Optional[BaseException] = None
         self._host_syncs = 0
         # Fault-injection seams (None in production): chaos drills route
@@ -442,14 +456,11 @@ class _TrialRun:
             # matches (train/checkpoint.py's CRC machinery); nothing
             # valid means retry from scratch. No strict errors here —
             # the supervisor's contract is "recover the most work
-            # possible", not "diagnose for a human".
-            got = restore_latest_valid(
-                self.state,
-                self._ckpt_path,
-                trial,
-                shardings=self._state_sh,
-                accept_meta=lambda meta: not self._config_mismatch(meta),
-            )
+            # possible", not "diagnose for a human". On a spanning
+            # submesh the choice is AGREED across owner processes
+            # (min-over-hosts valid step) so one host's torn view of
+            # the newest candidate cannot desynchronize SPMD.
+            got = self._restore_scan()
             if got is not None:
                 restored, meta, used = got
                 done = int(meta.get("completed_epochs", 0))
@@ -537,6 +548,91 @@ class _TrialRun:
             if saved.get(k) != current[k]
         }
 
+    def _restore_scan(self):
+        """Scan-back restore for supervised retries and elastic
+        restarts; returns ``(state, meta, used_path)`` or ``None`` for
+        scratch.
+
+        Single-owner submeshes take the plain local scan
+        (``restore_latest_valid``). A PROCESS-SPANNING submesh runs the
+        **cross-host restore agreement** (docs/RESILIENCE.md "Elastic
+        multi-host", ``train.checkpoint.agreed_restore_step``): every
+        owner verifies its candidates locally, the group agrees on the
+        min of the newest locally-valid steps and confirms everyone
+        holds the agreed candidate — over the coordination-service
+        sideband (``cluster.agree_min_int``), never an on-mesh
+        collective: recovery must work when the device world is the
+        broken thing. Shared-filesystem views can disagree
+        (close-to-open NFS races, a write torn under one reader) —
+        without the agreement, owners would resume different weights
+        and silently desync SPMD. Any disagreement degrades to scratch
+        on every owner, never an error: recovery must degrade, not
+        wedge.
+        """
+        def accept(meta: dict) -> bool:
+            return not self._config_mismatch(meta)
+
+        if not (jax.process_count() > 1 and self.trial.spans_processes):
+            return restore_latest_valid(
+                self.state,
+                self._ckpt_path,
+                self.trial,
+                shardings=self._state_sh,
+                accept_meta=accept,
+            )
+        from multidisttorch_tpu.train.checkpoint import agreed_restore_step
+
+        got = agreed_restore_step(
+            self._ckpt_path,
+            # Attempt-scoped agreement keys: a retried trial's new
+            # agreement never reads the previous attempt's votes (and
+            # every re-formed world gets a fresh coordinator anyway).
+            name=f"trial{self.cfg.trial_id}:a{self._attempt}",
+            participants=self.trial.owner_processes,
+            accept_meta=lambda meta: (
+                accept(meta) and int(meta.get("completed_epochs", 0)) >= 1
+            ),
+            timeout_s=self._agree_timeout_s,
+            what=(
+                f"trial {self.cfg.trial_id} restore agreement over "
+                f"submesh group {self.trial.group_id}"
+            ),
+            trial_id=self.cfg.trial_id,
+            group_id=self.trial.group_id,
+        )
+        if got is None:
+            return None  # disagreement degrades to scratch everywhere
+        _step, cand, meta = got
+        restored = restore_state(
+            self.state, cand, self.trial, shardings=self._state_sh
+        )
+        return restored, meta, cand
+
+    def _wedged_fetch(self, fn, what: str):
+        """Run a host-side device fetch under the wedge watchdog when
+        its result transits a cross-host collective (spanning submesh,
+        multi-controller). A peer that stopped dispatching blocks such
+        fetches forever; the watchdog converts that into a
+        ``WedgedCollective`` within the deadline. Local fetches call
+        straight through — no watchdog thread, no overhead."""
+        if (
+            not self._wedge_timeout_s
+            or jax.process_count() == 1
+            or not self.trial.spans_processes
+        ):
+            return fn()
+        from multidisttorch_tpu.parallel.cluster import (
+            WedgedCollective,
+            call_with_timeout,
+        )
+
+        return call_with_timeout(
+            fn,
+            self._wedge_timeout_s,
+            f"trial {self.cfg.trial_id} {what}",
+            error_cls=WedgedCollective,
+        )
+
     def _adopt_history(self, meta: dict) -> None:
         self.result.history = list(meta.get("history", []))
         if self.result.history:
@@ -603,6 +699,16 @@ class _TrialRun:
         try:
             yield
         except Exception as e:  # noqa: BLE001 — deferred to agreement
+            # Preemption-class failures (host going away, wedged or
+            # expired collective) are NOT writer-I/O failures to vote
+            # on at the next boundary — the distributed state is
+            # already unusable, and the next boundary's reduction
+            # would wedge too. Propagate immediately.
+            from multidisttorch_tpu.faults.inject import HostPreemption
+            from multidisttorch_tpu.parallel.cluster import AgreementTimeout
+
+            if isinstance(e, (HostPreemption, AgreementTimeout)):
+                raise
             if self._deferred_error is None:
                 self._deferred_error = e
 
@@ -619,13 +725,15 @@ class _TrialRun:
         """
         if not self._agree:
             return
+        from multidisttorch_tpu.parallel.cluster import WedgedCollective
         from multidisttorch_tpu.parallel.collectives import group_all_ok
 
         err, self._deferred_error = self._deferred_error, None
         # Deadline-bounded: a dead peer owner would otherwise hang this
         # reduction forever (the reference's exact lost-rank behavior).
-        # On expiry the TimeoutError propagates through the trial's
-        # normal failure isolation, naming the trial and boundary.
+        # On expiry a WedgedCollective propagates through the trial's
+        # normal failure isolation (classified as preemption), naming
+        # the trial and boundary.
         if not group_all_ok(
             self.trial,
             err is None,
@@ -634,6 +742,7 @@ class _TrialRun:
                 f"trial {self.cfg.trial_id} {where} health agreement "
                 f"over submesh group {self.trial.group_id}"
             ),
+            error_cls=WedgedCollective,
         ):
             if err is not None:
                 raise err
@@ -805,8 +914,14 @@ class _TrialRun:
                     yield
 
             # One fetch for the whole epoch's average (O(1)-syncs rule).
+            # Wedge-watchdog-bounded on spanning submeshes: the sum
+            # transits the step's cross-host reduction, so a peer that
+            # stopped dispatching wedges THIS fetch first.
             self._host_syncs += 1
-            avg = float(epoch_sum_dev) / n_per_epoch
+            avg = self._wedged_fetch(
+                lambda: float(epoch_sum_dev),
+                f"epoch {epoch} loss fetch",
+            ) / n_per_epoch
             # Device memory books ride the sync just paid (never the
             # dispatch hot loop) — sampled BEFORE the divergence gate
             # below so even a diverging trial's books close.
@@ -877,7 +992,10 @@ class _TrialRun:
                 # Exact-count divisor: every real row was evaluated, the
                 # padded rows carried weight 0.0.
                 self._host_syncs += 1
-                test_avg = float(test_sum_dev) / self.test_iter.num_rows
+                test_avg = self._wedged_fetch(
+                    lambda: float(test_sum_dev),
+                    f"epoch {epoch} test loss fetch",
+                ) / self.test_iter.num_rows
                 self._log("====> Test set loss: {:.4f}".format(test_avg))
                 epoch_record["test_loss"] = test_avg
                 self.result.final_test_loss = test_avg
@@ -947,7 +1065,10 @@ class _TrialRun:
                     # its own buffer in the sharded case).
                     jax.tree.map(lambda x: x.copy_to_host_async(), snap)
                     yield
-                    host_state = jax.device_get(snap)
+                    host_state = self._wedged_fetch(
+                        lambda: jax.device_get(snap),
+                        f"epoch {epoch} checkpoint snapshot fetch",
+                    )
                     # Checkpoint boundary is the trial's memory high-
                     # water moment (the gathered/host-bound snapshot is
                     # live alongside the training state) — sample it.
@@ -985,7 +1106,12 @@ class _TrialRun:
             self._agree_boundary(f"epoch {epoch} boundary work")
 
         # drain the pipeline so wall-clock covers real completion
-        jax.block_until_ready(self.state.params)
+        # (wedge-watchdog-bounded: the last dispatched steps hold
+        # cross-host collectives a lost peer never finishes)
+        self._wedged_fetch(
+            lambda: jax.block_until_ready(self.state.params),
+            "completion block_until_ready",
+        )
         with self._guard():
             self._join_ckpt()
         self.result.wall_s = time.time() - t0
@@ -1013,6 +1139,55 @@ class _TrialRun:
                     )
         self._agree_boundary("completion work")
         self._log(f"Done. time: {self.result.wall_s:f}")
+
+
+# --- graceful drain on SIGTERM/SIGINT (docs/RESILIENCE.md) ----------
+# run_hpo installs these around its scheduling loop. First signal: the
+# loop finishes the current dispatch cycle, lands every pending
+# checkpoint write, records all in-flight attempts as "preempted" in
+# the ledger (fsync'd), and raises HostPreemption — a supervised
+# worker maps that to cluster.PREEMPTION_EXIT_CODE
+# (supervision.exit_code_for), and a resumed run_hpo loses at most one
+# checkpoint cadence of work. Second signal: the operator means it —
+# the default disposition is restored and the signal re-raised.
+# Module-level state because the handler must outlive _run_hpo_body's
+# closures and signal.signal only works on the main thread.
+_DRAIN: dict = {"sig": None, "prev": None}
+
+
+def _install_drain_handlers() -> None:
+    import signal
+
+    _DRAIN["sig"] = None
+    if threading.current_thread() is not threading.main_thread():
+        return  # signal.signal is main-thread-only; drain unavailable
+
+    def on_signal(signum, frame):
+        if _DRAIN["sig"] is not None:
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        _DRAIN["sig"] = signum
+
+    prev = {}
+    for s in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev[s] = signal.signal(s, on_signal)
+        except (ValueError, OSError):  # embedded/exotic hosts
+            pass
+    _DRAIN["prev"] = prev
+
+
+def _restore_drain_handlers() -> None:
+    import signal
+
+    prev, _DRAIN["prev"] = _DRAIN.get("prev"), None
+    _DRAIN["sig"] = None
+    for s, h in (prev or {}).items():
+        try:
+            signal.signal(s, h)
+        except (ValueError, OSError):
+            pass
 
 
 def stack_bucket_key(cfg: TrialConfig) -> tuple:
@@ -1868,6 +2043,23 @@ def run_hpo(
     dead peer produces a diagnosable ``TimeoutError`` instead of an
     indefinite hang (default: ``MDT_AGREE_TIMEOUT_S`` env, else 600 s).
 
+    **Elastic multi-host** (docs/RESILIENCE.md "Elastic multi-host"):
+    ``resume="scan"`` is the elastic-restart resume mode — settled
+    trials are skipped via the ledger like ``resume=True``, but
+    unfinished trials restore through the supervised scan-back
+    (tolerating the torn/corrupt checkpoints a killed host leaves
+    behind), with a cross-host restore agreement on spanning submeshes
+    (min-over-owners valid step). Every cross-host device sync in the
+    driver is wedge-watchdog-bounded (``MDT_WEDGE_TIMEOUT_S``, default
+    = the agreement deadline): a peer that stops dispatching produces
+    a named ``WedgedCollective`` (classified as preemption) instead of
+    a hang. SIGTERM/SIGINT trigger a graceful drain: pending
+    checkpoint writes land, in-flight attempts are recorded
+    ``preempted`` in the ledger, and ``HostPreemption`` is raised (a
+    supervised worker exits ``cluster.PREEMPTION_EXIT_CODE``); a
+    second signal kills immediately. ``tools/sweep_supervisor.py``
+    turns these contracts into automatic world-shrink restarts.
+
     Returns results for locally-run trials, in config order.
     """
     if profile_dir is not None:
@@ -1878,31 +2070,35 @@ def run_hpo(
         import contextlib
 
         trace_ctx = contextlib.nullcontext()
-    with trace_ctx:
-        return _run_hpo_body(
-            configs,
-            train_data,
-            test_data,
-            groups=groups,
-            num_groups=num_groups,
-            out_dir=out_dir,
-            shard_across_trials=shard_across_trials,
-            save_images=save_images,
-            save_checkpoints=save_checkpoints,
-            verbose=verbose,
-            model_builder=model_builder,
-            model_parallel=model_parallel,
-            param_shardings_builder=param_shardings_builder,
-            resilient=resilient,
-            resume=resume,
-            stack_trials=stack_trials,
-            stack_max_lanes=stack_max_lanes,
-            retry=retry,
-            fault_plan=fault_plan,
-            ledger=ledger,
-            ckpt_keep_last=ckpt_keep_last,
-            agree_timeout_s=agree_timeout_s,
-        )
+    _install_drain_handlers()
+    try:
+        with trace_ctx:
+            return _run_hpo_body(
+                configs,
+                train_data,
+                test_data,
+                groups=groups,
+                num_groups=num_groups,
+                out_dir=out_dir,
+                shard_across_trials=shard_across_trials,
+                save_images=save_images,
+                save_checkpoints=save_checkpoints,
+                verbose=verbose,
+                model_builder=model_builder,
+                model_parallel=model_parallel,
+                param_shardings_builder=param_shardings_builder,
+                resilient=resilient,
+                resume=resume,
+                stack_trials=stack_trials,
+                stack_max_lanes=stack_max_lanes,
+                retry=retry,
+                fault_plan=fault_plan,
+                ledger=ledger,
+                ckpt_keep_last=ckpt_keep_last,
+                agree_timeout_s=agree_timeout_s,
+            )
+    finally:
+        _restore_drain_handlers()
 
 
 def predicted_cost(cfg: TrialConfig, train_rows: int) -> int:
@@ -2038,6 +2234,17 @@ def _run_hpo_body(
         from multidisttorch_tpu.parallel.cluster import _env_timeout
 
         agree_timeout_s = _env_timeout("MDT_AGREE_TIMEOUT_S", 600.0)
+    # The wedge watchdog's deadline for device-result fetches on
+    # spanning submeshes (epoch/test loss, checkpoint gather,
+    # completion drain): MDT_WEDGE_TIMEOUT_S, defaulting to the
+    # agreement deadline so one knob bounds every cross-host sync.
+    from multidisttorch_tpu.parallel.cluster import (
+        _env_timeout as _wedge_env_timeout,
+    )
+
+    wedge_timeout_s = _wedge_env_timeout(
+        "MDT_WEDGE_TIMEOUT_S", agree_timeout_s
+    )
     # The sweep's durable control state: every attempt's config hash and
     # outcome. Writes are fsync'd JSONL appends (crash = at most one
     # torn, skipped line); only process 0 writes, every process reads
@@ -2087,7 +2294,7 @@ def _run_hpo_body(
             )
 
     def make_run(
-        trial: TrialMesh, cfg: TrialConfig, resume_mode
+        trial: TrialMesh, cfg: TrialConfig, resume_mode, attempt: int = 1
     ) -> _TrialRun:
         return _TrialRun(
             trial,
@@ -2109,8 +2316,10 @@ def _run_hpo_body(
             resume=resume_mode,
             agree_failures=needs_agreement(trial),
             agree_timeout_s=agree_timeout_s,
+            wedge_timeout_s=wedge_timeout_s,
             injector=injector,
             ckpt_keep_last=ckpt_keep_last,
+            attempt=attempt,
         )
 
     # Queue configs per group. Single-controller: one shared queue,
@@ -2293,8 +2502,9 @@ def _run_hpo_body(
         # on a spanning submesh every owner must make identical
         # scheduling decisions without communicating, so multi-
         # controller retries requeue immediately (FIFO order is shared
-        # state; clocks are not).
-        delay = retry.backoff_s(fails) if single else 0.0
+        # state; clocks are not). key= decorrelates jittered backoff
+        # across trials felled by the same fault (thundering herd).
+        delay = retry.backoff_s(fails, key=cfg.trial_id) if single else 0.0
         bus = get_bus()
         if bus is not None:
             bus.emit(
@@ -2318,20 +2528,29 @@ def _run_hpo_body(
         )
         return True
 
-    def record_preempted_peers() -> None:
-        """A preemption kills the whole driver, not one trial: every
-        other in-flight attempt (single runs AND stacked-bucket lanes)
-        dies with it. Record them all so restart accounting and the
-        chaos goodput math see the full picture."""
+    def record_preempted_peers(
+        error_text: str = "host preemption (sweep-wide)",
+    ) -> None:
+        """A preemption (or drain) kills the whole driver, not one
+        trial: every other in-flight attempt (single runs AND
+        stacked-bucket lanes) dies with it. Record them all so restart
+        accounting and the chaos goodput math see the full picture —
+        after landing any in-flight checkpoint write (best-effort: the
+        resumed sweep restores from it, so a write racing the death
+        must finish, not vanish with its thread)."""
         for _gid, (k2, i2, run2, _g2) in list(active.items()):
             if k2 == "single":
+                try:
+                    run2._join_ckpt()
+                except Exception:  # noqa: BLE001 — recording must go on
+                    pass
                 led.attempt_end(
                     run2.cfg.trial_id, chashes[i2], attempts[i2],
-                    "preempted", error="host preemption (sweep-wide)",
+                    "preempted", error=error_text,
                     summary=attempt_progress(run2),
                 )
             else:
-                run2.record_preempted("host preemption (sweep-wide)")
+                run2.record_preempted(error_text)
 
     def next_ready_at() -> Optional[float]:
         queues = [shared] if single else [
@@ -2390,7 +2609,11 @@ def _run_hpo_body(
                         and setup_class == INFRA
                         and retry.should_retry(fails, INFRA)
                     ):
-                        delay = retry.backoff_s(fails) if single else 0.0
+                        delay = (
+                            retry.backoff_s(fails, key=members[0][0])
+                            if single
+                            else 0.0
+                        )
                         q.append(("bucket", members, time.time() + delay))
                         log0(
                             f"Stacked bucket of {len(members)} trials "
@@ -2421,7 +2644,7 @@ def _run_hpo_body(
             err: Optional[BaseException] = None
             run: Optional[_TrialRun] = None
             try:
-                run = make_run(g, cfg, resume_mode)
+                run = make_run(g, cfg, resume_mode, attempt=attempts[i])
             except Exception as e:  # noqa: BLE001 — setup failure isolation
                 err = e
             if needs_agreement(g):
@@ -2429,6 +2652,9 @@ def _run_hpo_body(
                 # start stepping or all skip — an asymmetric setup
                 # failure (e.g. one host's data path) would otherwise
                 # leave peers dispatching a trial that never runs here.
+                from multidisttorch_tpu.parallel.cluster import (
+                    WedgedCollective,
+                )
                 from multidisttorch_tpu.parallel.collectives import (
                     group_all_ok,
                 )
@@ -2438,6 +2664,7 @@ def _run_hpo_body(
                     err is None,
                     timeout_s=agree_timeout_s,
                     what=f"trial {cfg.trial_id} setup agreement",
+                    error_cls=WedgedCollective,
                 )
             else:
                 ok = err is None
@@ -2498,6 +2725,23 @@ def _run_hpo_body(
             skipped_settled=len(skipped),
         )
 
+    def drain_now():
+        from multidisttorch_tpu.faults.inject import (
+            HostPreemption as _Drained,
+        )
+
+        sig = _DRAIN["sig"]
+        error_text = f"graceful drain on signal {sig}"
+        dbus = get_bus()
+        if dbus is not None:
+            dbus.emit("sweep_drain", signal=int(sig), in_flight=len(active))
+        record_preempted_peers(error_text)
+        raise _Drained(
+            f"{error_text}: in-flight work checkpointed to the last "
+            "epoch boundary and recorded in the ledger; resume with "
+            "run_hpo(resume=True)"
+        )
+
     for g in local_groups:
         start_next(g)
 
@@ -2509,6 +2753,8 @@ def _run_hpo_body(
     # never block live work; when ONLY backoff items remain, the loop
     # sleeps to the earliest deadline.
     while True:
+        if _DRAIN["sig"] is not None:
+            drain_now()
         for g in local_groups:
             if g.group_id not in active:
                 start_next(g)  # a backoff retry may have matured
@@ -2516,7 +2762,15 @@ def _run_hpo_body(
             deadline = next_ready_at()
             if deadline is None:
                 break
-            time.sleep(max(0.0, deadline - time.time()) + 1e-3)
+            # Sliced sleep: a SIGTERM during a long backoff wait only
+            # sets the drain flag (PEP 475 resumes the sleep), so one
+            # monolithic sleep of up to backoff_max_s would outlast a
+            # supervisor's kill grace and forfeit the drain. Wake every
+            # quarter-second to honor the flag promptly.
+            while time.time() < deadline and _DRAIN["sig"] is None:
+                time.sleep(
+                    min(0.25, max(0.0, deadline - time.time()) + 1e-3)
+                )
             continue
         for g in local_groups:
             if g.group_id not in active:

@@ -34,6 +34,28 @@ def config_hash(cfg_dict: dict) -> str:
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
+def wasted_steps(ev: dict) -> int:
+    """Executed-but-wasted optimizer steps carried by ONE ledger event:
+    a non-settled ``attempt_end``'s progress beyond its own resume
+    point, or a ``compacted`` summary's carried total; 0 for anything
+    else. The single copy of the goodput denominator's per-event fold —
+    :meth:`SweepLedger.compact`, the chaos bench, and the multi-host
+    drill all share it, so a new status or summary field name changes
+    in one place."""
+    if ev.get("event") == "compacted":
+        return max(0, int(ev.get("wasted_steps", 0) or 0))
+    if ev.get("event") != "attempt_end" or ev.get("status") not in (
+        "retrying", "preempted", "failed",
+    ):
+        return 0
+    s = ev.get("summary") or {}
+    return max(
+        0,
+        int(s.get("steps_at_failure", 0) or 0)
+        - int(s.get("resumed_from_step", 0) or 0),
+    )
+
+
 class SweepLedger:
     """Append-only JSONL event log under ``{out_dir}/sweep_ledger.jsonl``.
 
@@ -188,26 +210,164 @@ class SweepLedger:
     def attempts(self) -> dict[str, int]:
         """config_hash -> number of attempt_start events seen (so a
         restarted driver continues the attempt numbering, keeping the
-        ledger's history monotonic)."""
+        ledger's history monotonic). ``compacted`` summary records
+        (written by :meth:`compact`) carry forward the pre-compaction
+        maximum."""
         counts: dict[str, int] = {}
         for ev in self.load():
-            if ev.get("event") == "attempt_start" and ev.get("config_hash"):
-                h = ev["config_hash"]
+            h = ev.get("config_hash")
+            if not h:
+                continue
+            if ev.get("event") == "attempt_start":
                 counts[h] = max(counts.get(h, 0), int(ev.get("attempt", 0)))
+            elif (
+                ev.get("event") == "compacted"
+                and int(ev.get("attempts", 0)) > 0
+            ):
+                counts[h] = max(counts.get(h, 0), int(ev["attempts"]))
         return counts
 
     def infra_failures(self) -> dict[str, int]:
         """config_hash -> infra failures recorded so far ("retrying" /
         "failed" attempt_ends). The restarted driver seeds its retry
         budgets from this — preempted attempts deliberately do NOT
-        count (RetryPolicy.should_retry's contract)."""
+        count (RetryPolicy.should_retry's contract). ``compacted``
+        summary records carry the failures whose individual events
+        compaction dropped."""
         counts: dict[str, int] = {}
         for ev in self.load():
+            h = ev.get("config_hash")
+            if not h:
+                continue
             if (
                 ev.get("event") == "attempt_end"
-                and ev.get("config_hash")
                 and ev.get("status") in ("retrying", "failed")
             ):
-                h = ev["config_hash"]
                 counts[h] = counts.get(h, 0) + 1
+            elif (
+                ev.get("event") == "compacted"
+                and int(ev.get("infra_failures", 0)) > 0
+            ):
+                # zero carries add nothing — and must not materialize
+                # entries the un-compacted fold never had
+                counts[h] = counts.get(h, 0) + int(ev["infra_failures"])
         return counts
+
+    # -- compaction ---------------------------------------------------
+
+    def compact(self) -> dict:
+        """Atomically rewrite the ledger to its minimal equivalent
+        state.
+
+        A restart storm (elastic world shrinks, preemption loops,
+        retry-heavy chaos runs) appends attempt history without bound —
+        every restarted driver then re-folds the whole file. Compaction
+        keeps, per config hash, exactly what the three restart folds
+        (:meth:`finished`, :meth:`attempts`, :meth:`infra_failures`)
+        need:
+
+        - one ``compacted`` summary record carrying the attempt
+          high-water mark and the infra-failure count of the DROPPED
+          events,
+        - the newest ``attempt_start`` and the newest ``attempt_end``
+          verbatim, in their original relative order (so a settlement
+          invalidated by a later re-run start stays invalidated).
+
+        The rewrite lands via tmp + fsync + ``os.replace`` + dir fsync
+        — a crash mid-compaction leaves the old ledger intact; a torn
+        tail in the input is skipped by :meth:`load` like any other
+        read. Returns ``{"lines_before", "lines_after", "hashes"}``
+        (zeros when the ledger is disabled or this process is not the
+        writer — compaction respects the same write gate as appends).
+        """
+        if not self.write or not os.path.exists(self.path):
+            return {"lines_before": 0, "lines_after": 0, "hashes": 0}
+        events = self.load()
+        per_hash: dict[str, dict] = {}
+        other: list[dict] = []  # hash-less events survive verbatim
+        for idx, ev in enumerate(events):
+            h = ev.get("config_hash")
+            if not h or ev.get("event") not in (
+                "attempt_start", "attempt_end", "compacted"
+            ):
+                other.append(ev)
+                continue
+            rec = per_hash.setdefault(
+                h,
+                {
+                    "first_idx": idx,
+                    "trial_id": ev.get("trial_id"),
+                    "start": None,
+                    "end": None,
+                    "attempts": 0,
+                    "infra": 0,
+                    "wasted": 0,
+                },
+            )
+            if ev.get("event") == "attempt_start":
+                rec["start"] = (idx, ev)
+                rec["attempts"] = max(
+                    rec["attempts"], int(ev.get("attempt", 0))
+                )
+            elif ev.get("event") == "attempt_end":
+                rec["end"] = (idx, ev)
+                if ev.get("status") in ("retrying", "failed"):
+                    rec["infra"] += 1
+                rec["wasted"] += wasted_steps(ev)
+            else:  # an earlier compaction's summary folds in
+                rec["attempts"] = max(
+                    rec["attempts"], int(ev.get("attempts", 0))
+                )
+                rec["infra"] += int(ev.get("infra_failures", 0))
+                rec["wasted"] += wasted_steps(ev)
+        out: list[dict] = list(other)
+        for h, rec in sorted(
+            per_hash.items(), key=lambda kv: kv[1]["first_idx"]
+        ):
+            kept = [p for p in (rec["start"], rec["end"]) if p is not None]
+            kept.sort(key=lambda p: p[0])  # original relative order
+            # The summary counts only what is NOT kept verbatim, so the
+            # infra_failures fold never double-counts the retained end.
+            kept_infra = sum(
+                1
+                for _, ev in kept
+                if ev.get("event") == "attempt_end"
+                and ev.get("status") in ("retrying", "failed")
+            )
+            kept_wasted = sum(wasted_steps(ev) for _, ev in kept)
+            out.append(
+                {
+                    "event": "compacted",
+                    "config_hash": h,
+                    "trial_id": rec["trial_id"],
+                    "attempts": rec["attempts"],
+                    "infra_failures": max(0, rec["infra"] - kept_infra),
+                    # Executed-but-wasted steps of the DROPPED
+                    # non-settled attempt_ends (goodput's denominator
+                    # input — the chaos accounting must not lose wasted
+                    # work to compaction).
+                    "wasted_steps": max(0, rec["wasted"] - kept_wasted),
+                    "ts": time.time(),
+                }
+            )
+            out.extend(ev for _, ev in kept)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            for ev in out:
+                f.write(json.dumps(ev, default=str) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        try:  # durably record the rename (best-effort, like checkpoint.py)
+            fd = os.open(os.path.dirname(self.path) or ".", os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
+        return {
+            "lines_before": len(events),
+            "lines_after": len(out),
+            "hashes": len(per_hash),
+        }

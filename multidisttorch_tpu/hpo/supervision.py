@@ -98,6 +98,18 @@ def _classify(exc: BaseException) -> str:
     return INFRA
 
 
+def exit_code_for(exc: BaseException) -> int:
+    """The exit-code contract for a supervised worker process dying on
+    ``exc`` (docs/RESILIENCE.md "Elastic multi-host"): preemption-class
+    failures — host preemption, a wedged collective, a graceful drain —
+    exit with ``cluster.PREEMPTION_EXIT_CODE`` so the elastic
+    supervisor re-admits the host into the next world; anything else
+    exits 1 (the host itself is suspect)."""
+    from multidisttorch_tpu.parallel.cluster import PREEMPTION_EXIT_CODE
+
+    return PREEMPTION_EXIT_CODE if _classify(exc) == PREEMPTION else 1
+
+
 @dataclass(frozen=True)
 class RetryPolicy:
     """Retry budget for infra-class failures.
@@ -108,12 +120,25 @@ class RetryPolicy:
     backoff_max_s)`` — capped exponential. The default base of 0.05 s
     keeps CI fast while still exercising the deadline machinery; a
     production sweep facing real preempt/restart storms raises it.
+
+    ``jitter=True`` switches to **decorrelated jitter** (the AWS
+    backoff shape): retry k sleeps ``uniform(base, 3 * previous_sleep)``
+    capped at ``backoff_max_s``. Without it, N lanes felled by the SAME
+    injected (or real) fault — a dead data host, a shared-FS blip —
+    wake in lockstep and re-hammer the resource that just failed them.
+    The jitter stream is a pure function of ``(jitter_seed, key,
+    retry_number)`` — no hidden RNG state — so a seeded chaos run
+    replays bit-identical backoff schedules (``key`` is the caller's
+    decorrelation identity, the trial id in the HPO driver: same trial
+    same delays, different trials different delays).
     """
 
     max_retries: int = 2
     backoff_base_s: float = 0.05
     backoff_factor: float = 2.0
     backoff_max_s: float = 30.0
+    jitter: bool = False
+    jitter_seed: int = 0
 
     def __post_init__(self):
         if self.max_retries < 0:
@@ -127,14 +152,37 @@ class RetryPolicy:
                 f"backoff_factor must be >= 1, got {self.backoff_factor}"
             )
 
-    def backoff_s(self, retry_number: int) -> float:
-        """Backoff before the ``retry_number``-th retry (1-based)."""
+    def backoff_s(self, retry_number: int, *, key: int = 0) -> float:
+        """Backoff before the ``retry_number``-th retry (1-based).
+        ``key`` decorrelates concurrent failure domains under
+        ``jitter=True`` (ignored otherwise — the deterministic capped
+        exponential is bit-stable for existing callers)."""
         if retry_number < 1:
             raise ValueError(f"retry_number is 1-based, got {retry_number}")
-        return min(
-            self.backoff_base_s * self.backoff_factor ** (retry_number - 1),
-            self.backoff_max_s,
-        )
+        if not self.jitter:
+            return min(
+                self.backoff_base_s
+                * self.backoff_factor ** (retry_number - 1),
+                self.backoff_max_s,
+            )
+        import numpy as np
+
+        # Decorrelated chain, recomputed deterministically from the
+        # start: sleep_k ~ uniform(base, 3 * sleep_{k-1}), each draw
+        # from its own (seed, key, k)-derived stream so the value for
+        # retry k never depends on how many times this method ran.
+        sleep = self.backoff_base_s
+        for k in range(1, retry_number + 1):
+            rng = np.random.default_rng(
+                np.random.SeedSequence(
+                    [self.jitter_seed & 0xFFFFFFFF, key & 0xFFFFFFFF, k]
+                )
+            )
+            hi = max(self.backoff_base_s, 3.0 * sleep)
+            sleep = min(
+                self.backoff_max_s, rng.uniform(self.backoff_base_s, hi)
+            )
+        return sleep
 
     def should_retry(self, infra_failures: int, failure_class: str) -> bool:
         """Whether to schedule another attempt after the trial's

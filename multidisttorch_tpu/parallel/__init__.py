@@ -1,5 +1,8 @@
 from multidisttorch_tpu.parallel.cluster import (
+    PREEMPTION_EXIT_CODE,
+    AgreementTimeout,
     ProcessEnv,
+    WedgedCollective,
     coordinator_address,
     detect_process_env,
     find_ifname,

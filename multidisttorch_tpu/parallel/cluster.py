@@ -301,7 +301,38 @@ class AgreementTimeout(TimeoutError):
     """
 
 
-def call_with_timeout(fn, timeout_s: Optional[float], what: str):
+class WedgedCollective(AgreementTimeout):
+    """A device-sync point (host barrier, submesh agreement, epoch-loss
+    fetch, completion ``block_until_ready``) wedged past its deadline.
+
+    The watchdog's verdict on a stuck cross-host collective: a peer
+    stopped dispatching (wedged, preempted, dead NIC) and this process
+    is blocked on a result that will never arrive. Subclasses
+    :class:`AgreementTimeout`, so supervision classifies it as
+    preemption (die, restart against the ledger) — the extra type names
+    *which* failure mode for the exit-code contract: a supervised
+    worker catching this exits with :data:`PREEMPTION_EXIT_CODE` so an
+    elastic supervisor (``tools/sweep_supervisor.py``) can tell
+    "healthy host, lost world" from a genuine crash.
+    """
+
+
+# The exit-code contract (docs/RESILIENCE.md "Elastic multi-host"):
+# a supervised worker that dies because the *world* failed around it —
+# host preemption, a wedged collective, a graceful SIGTERM drain —
+# exits with this code (BSD EX_TEMPFAIL: "try again"). The supervisor
+# re-admits such hosts into the next, possibly smaller, world; any
+# other non-zero exit marks the host itself as lost.
+PREEMPTION_EXIT_CODE = 75
+
+
+def call_with_timeout(
+    fn,
+    timeout_s: Optional[float],
+    what: str,
+    *,
+    error_cls: type = AgreementTimeout,
+):
     """Run ``fn()`` with a wall-clock deadline; raise a *diagnosable*
     :class:`AgreementTimeout` naming ``what`` instead of hanging
     forever.
@@ -315,6 +346,17 @@ def call_with_timeout(fn, timeout_s: Optional[float], what: str):
     the process), which is the honest trade for turning an indefinite
     hang into an actionable error. ``timeout_s=None`` or <= 0 means no
     deadline (direct call).
+
+    ``error_cls`` selects the raised type (must accept one message
+    argument): the driver's device-sync watchdogs pass
+    :class:`WedgedCollective` so the failure names itself; the default
+    stays :class:`AgreementTimeout` for generic coordination calls.
+
+    The runner thread MUST be a daemon: on expiry the blocked ``fn`` is
+    abandoned mid-call, and a non-daemon leak would make interpreter
+    shutdown join a thread that never returns — the process would
+    survive its own timeout just to hang at exit (regression-tested in
+    tests/test_elastic.py).
     """
     if timeout_s is None or timeout_s <= 0:
         return fn()
@@ -332,7 +374,7 @@ def call_with_timeout(fn, timeout_s: Optional[float], what: str):
     t.start()
     t.join(timeout_s)
     if t.is_alive():
-        raise AgreementTimeout(
+        raise error_cls(
             f"{what} did not complete within {timeout_s:g}s — a "
             "participating process is likely dead, preempted, or hung. "
             "The blocked collective was abandoned on a daemon thread; "
@@ -351,6 +393,106 @@ def _env_timeout(env_var: str, default: Optional[float]) -> Optional[float]:
     return float(raw)
 
 
+def coordination_client():
+    """The distributed runtime's coordination-service client, or None
+    (single-process, or jax's internals moved).
+
+    The sideband channel for cross-host agreement that must work even
+    when the accelerator backend cannot (a wedged TPU plugin, or
+    XLA:CPU's missing multiprocess computations): a host barrier and a
+    key-value store served by the coordinator process, independent of
+    any compiled collective."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # pragma: no cover — jax internals moved
+        return None
+
+
+_UNBOUNDED_MS = 2**31 - 1  # "no deadline" for coordination-service waits
+
+
+def agree_min_int(
+    name: str,
+    value: int,
+    participants,
+    *,
+    timeout_s: Optional[float],
+    what: str,
+    error_cls: type = None,
+) -> int:
+    """Agree on the MINIMUM of a per-process integer across
+    ``participants`` (process indices) via the coordination-service
+    key-value store — the **sideband agreement** primitive.
+
+    Unlike an on-mesh reduction (``collectives.group_min_scalar``) this
+    never touches a compiled collective, so it works during recovery —
+    exactly when the device world may be the thing that is broken —
+    and on backends without cross-process XLA computations (CPU). Keys
+    are scoped by ``name``; callers make names unique per agreement
+    instance (the driver uses ``trial:attempt``), and every world
+    restart gets a fresh coordinator so stale keys cannot leak across
+    worlds.
+
+    A participant that never shows up turns into ``error_cls``
+    (default :class:`WedgedCollective`) within ``timeout_s`` — the
+    no-hang contract. Single-process (or a single participant) returns
+    ``value`` unchanged.
+    """
+    if error_cls is None:
+        error_cls = WedgedCollective
+    participants = sorted(int(p) for p in participants)
+    import jax
+
+    if len(participants) <= 1 or jax.process_count() == 1:
+        return int(value)
+    client = coordination_client()
+    if client is None:
+        raise error_cls(
+            f"{what}: no coordination-service client available for the "
+            f"sideband agreement {name!r} (distributed runtime not "
+            "initialized?)"
+        )
+    pid = jax.process_index()
+    timeout_ms = (
+        int(timeout_s * 1000)
+        if timeout_s and timeout_s > 0
+        else _UNBOUNDED_MS
+    )
+    try:
+        client.key_value_set(f"{name}:p{pid}", str(int(value)))
+        values = [
+            int(client.blocking_key_value_get(f"{name}:p{q}", timeout_ms))
+            for q in participants
+        ]
+    except Exception as e:
+        raise error_cls(
+            f"{what} did not complete within "
+            f"{(timeout_ms / 1000.0):g}s — a participant of the sideband "
+            f"agreement {name!r} (processes {participants}) is missing: "
+            "likely dead, preempted, or wedged. Treat this process's "
+            "distributed state as unusable and restart against the "
+            "sweep ledger."
+        ) from e
+    return min(values)
+
+
+import itertools as _itertools
+
+# Barrier ids must be unique per invocation; processes call sync_hosts
+# at the same points (the documented collective-cadence contract), so a
+# per-process counter yields matching ids everywhere.
+_sync_barrier_counter = _itertools.count()
+
+# Backend-capability verdict, cached after the first probe: whether
+# this process's backend can run cross-process XLA computations at all
+# (XLA:CPU cannot). Constant per process — re-probing would pay a
+# doomed collective compile + a leaked watchdog thread on EVERY CPU
+# barrier.
+_xla_sync_unsupported = False
+
+
 def sync_hosts(name: str = "sync", *, timeout_s: Optional[float] = None) -> None:
     """Barrier across host processes (multi-controller only).
 
@@ -363,13 +505,21 @@ def sync_hosts(name: str = "sync", *, timeout_s: Optional[float] = None) -> None
 
     ``timeout_s`` (default: ``MDT_SYNC_TIMEOUT_S`` env var, else 1800)
     bounds the wait: a dead peer turns into a descriptive
-    :class:`AgreementTimeout` naming the barrier instead of an
+    :class:`WedgedCollective` naming the barrier instead of an
     indefinite hang — the reference's unbounded ``dist.barrier()`` is
     exactly the failure this guards against. The default is deliberately
     generous (30 min): this barrier's documented use is "wait while one
     host downloads the dataset", which is legitimately slow; jobs whose
     barriers wait even longer pass ``timeout_s`` explicitly or ``0`` /
     ``MDT_SYNC_TIMEOUT_S=0`` for the old unbounded behavior.
+
+    Backend-agnostic: ``sync_global_devices`` compiles a cross-process
+    collective, which XLA:CPU does not implement ("Multiprocess
+    computations aren't implemented") — there the barrier degrades to
+    the coordination-service host barrier, same semantics for host-side
+    coordination, natively deadline-bounded (no watchdog thread to
+    leak). The elastic chaos drills exercise the wedge path through
+    exactly this barrier.
     """
     import jax
 
@@ -378,11 +528,53 @@ def sync_hosts(name: str = "sync", *, timeout_s: Optional[float] = None) -> None
 
         if timeout_s is None:
             timeout_s = _env_timeout("MDT_SYNC_TIMEOUT_S", 1800.0)
-        call_with_timeout(
-            lambda: multihost_utils.sync_global_devices(name),
-            timeout_s,
-            f"host barrier {name!r} over {jax.process_count()} processes",
+        global _xla_sync_unsupported
+        what = (
+            f"host barrier {name!r} over {jax.process_count()} processes"
         )
+        if not _xla_sync_unsupported:
+            try:
+                call_with_timeout(
+                    lambda: multihost_utils.sync_global_devices(name),
+                    timeout_s,
+                    what,
+                    # A stuck barrier IS a wedged collective: name it so
+                    # the exit-code contract (and the supervisor) react.
+                    error_cls=WedgedCollective,
+                )
+                return
+            except WedgedCollective:
+                raise
+            except Exception as e:  # noqa: BLE001 — capability probe
+                if "Multiprocess computations" not in str(e):
+                    raise
+                # XLA:CPU: fall back to the coordination-service
+                # barrier, and remember the verdict — it is constant
+                # per process. Every process of a CPU world raises
+                # identically, so all participants fall back together.
+                _xla_sync_unsupported = True
+        client = coordination_client()
+        if client is None:
+            raise RuntimeError(
+                f"{what}: backend cannot run multiprocess computations "
+                "and no coordination-service client is available"
+            )
+        bid = f"mdt:sync:{name}:{next(_sync_barrier_counter)}"
+        timeout_ms = (
+            int(timeout_s * 1000)
+            if timeout_s and timeout_s > 0
+            else _UNBOUNDED_MS
+        )
+        try:
+            client.wait_at_barrier(bid, timeout_ms)
+        except Exception as e:
+            raise WedgedCollective(
+                f"{what} did not complete within "
+                f"{(timeout_ms / 1000.0):g}s — a participating process "
+                "is likely dead, preempted, or wedged. Treat this "
+                "process's distributed state as unusable and restart "
+                "the job (the sweep ledger makes the restart cheap)."
+            ) from e
 
 
 def process_world() -> tuple[int, int]:

@@ -123,6 +123,7 @@ def group_all_ok(
     *,
     timeout_s: float | None = None,
     what: str = "group health agreement",
+    error_cls: type | None = None,
 ) -> bool:
     """Cross-process health agreement scoped to ONE trial submesh.
 
@@ -147,13 +148,22 @@ def group_all_ok(
     the stuck collective is abandoned on a daemon thread; the caller
     should treat the group as lost and restart against the sweep
     ledger). ``None``/0 = unbounded, the pre-timeout behavior.
+    ``error_cls`` names the raised type on expiry (default
+    ``AgreementTimeout``; the HPO driver's device-sync points pass
+    ``cluster.WedgedCollective`` for the exit-code contract).
     """
     import time
 
     import numpy as np
 
-    from multidisttorch_tpu.parallel.cluster import call_with_timeout
+    from multidisttorch_tpu.parallel.cluster import (
+        AgreementTimeout,
+        call_with_timeout,
+    )
     from multidisttorch_tpu.telemetry.events import get_bus
+
+    if error_cls is None:
+        error_cls = AgreementTimeout
 
     def agree() -> bool:
         n = trial.size
@@ -174,12 +184,14 @@ def group_all_ok(
 
     bus = get_bus()
     if bus is None:
-        return call_with_timeout(agree, timeout_s, what)
+        return call_with_timeout(agree, timeout_s, what, error_cls=error_cls)
     # Telemetry seam: agreement latency is the sweep's cross-process
     # sync cost — a slow peer shows up here long before it times out.
     t0 = time.perf_counter()
     try:
-        agreed = call_with_timeout(agree, timeout_s, what)
+        agreed = call_with_timeout(
+            agree, timeout_s, what, error_cls=error_cls
+        )
     except BaseException as e:
         bus.emit(
             "agreement",
@@ -198,3 +210,60 @@ def group_all_ok(
         wall_s=round(time.perf_counter() - t0, 6),
     )
     return agreed
+
+
+@lru_cache(maxsize=None)
+def _min_flags_fn(mesh: Mesh):
+    from jax.sharding import NamedSharding
+
+    return jax.jit(jnp.min, out_shardings=NamedSharding(mesh, P()))
+
+
+def group_min_scalar(
+    trial: TrialMesh,
+    value: int,
+    *,
+    timeout_s: float | None = None,
+    what: str = "group min agreement",
+    error_cls: type | None = None,
+) -> int:
+    """Agree on the MINIMUM of a per-process integer across one trial
+    submesh's owner processes — the on-mesh sibling of
+    :func:`group_all_ok` for value (not just health) agreement.
+
+    Note the RECOVERY path deliberately does not use this: the
+    cross-host restore agreement (``train.checkpoint.
+    agreed_restore_step``) rides the coordination-service sideband
+    (``cluster.agree_min_int``) instead, because it must work when the
+    device world is the broken thing — and on backends without
+    cross-process XLA computations. This on-mesh form is for healthy
+    in-band coordination (e.g. agreeing a shared schedule knob on ICI
+    without touching the coordinator).
+
+    Same collective contract, timeout semantics, and ``error_cls``
+    behavior as :func:`group_all_ok` (one tiny submesh-scoped
+    reduction; no world barrier).
+    """
+    import numpy as np
+
+    from multidisttorch_tpu.parallel.cluster import (
+        AgreementTimeout,
+        call_with_timeout,
+    )
+
+    if error_cls is None:
+        error_cls = AgreementTimeout
+
+    def agree() -> int:
+        n = trial.size
+        sharding = trial.sharding(tuple(trial.mesh.axis_names))
+        local = np.full(1, int(value), np.int32)
+        if jax.process_count() == 1:
+            flags = jax.device_put(np.full(n, local[0], np.int32), sharding)
+        else:
+            flags = jax.make_array_from_callback(
+                (n,), sharding, lambda idx: local
+            )
+        return int(_min_flags_fn(trial.mesh)(flags))
+
+    return call_with_timeout(agree, timeout_s, what, error_cls=error_cls)

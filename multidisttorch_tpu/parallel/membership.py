@@ -1,0 +1,350 @@
+"""Sideband heartbeat membership for elastic multi-host sweeps.
+
+The multi-host failure detector must NOT ride the thing it is
+detecting failures of: a collective-based health check wedges exactly
+when the world it probes wedges (the reference's all-or-nothing
+steady-state, SURVEY.md §5). So membership here is pure sideband state
+— per-host **lease files** on the run directory's shared filesystem,
+one append-only JSONL stream per host slot:
+
+    {run_dir}/membership/host-{slot}.jsonl
+    {"slot": 1, "pid": 4242, "ts": ..., "seq": 17, "status": "alive",
+     "world_epoch": 0, "world_size": 3, "hostname": "..."}
+
+Appends either land whole or tear the final line; readers skip
+undecodable lines (the sweep ledger's crash model, ``hpo/ledger.py``).
+A host is **lost** when its newest decodable lease is older than the
+detection deadline and does not say ``"left"`` — dead processes,
+SIGKILLed hosts, and wedged processes whose heartbeat thread stopped
+making progress all look identical here, which is the point: the
+supervisor (``tools/sweep_supervisor.py``) needs one verdict, "this
+host is not coming back", without touching a collective.
+
+The writer side is a tiny daemon thread (:class:`Heartbeat`); the
+fault injector's WEDGE kind calls :func:`suspend_heartbeat` so a
+simulated stuck host goes lease-stale exactly like a real one. No jax
+import at module level — the supervisor process uses this without a
+device runtime.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Optional
+
+MEMBERSHIP_DIRNAME = "membership"
+LEASE_PREFIX = "host-"
+WORLDS_NAME = "worlds.jsonl"
+
+ALIVE = "alive"
+DRAINING = "draining"
+LEFT = "left"
+
+
+def membership_dir(run_dir: str) -> str:
+    return os.path.join(run_dir, MEMBERSHIP_DIRNAME)
+
+
+def lease_path(run_dir: str, slot: int) -> str:
+    return os.path.join(membership_dir(run_dir), f"{LEASE_PREFIX}{slot}.jsonl")
+
+
+def emit_event(kind: str, **data) -> None:
+    """Typed membership telemetry (``host_lost`` / ``world_shrunk`` /
+    ``trial_migrated`` ride this seam) — zero-cost-when-off contract.
+    Public: the supervisor emits its verdicts through the same seam."""
+    from multidisttorch_tpu.telemetry.events import get_bus
+
+    bus = get_bus()
+    if bus is not None:
+        bus.emit(kind, **data)
+
+
+def read_lease(path: str) -> list[dict]:
+    """All decodable lease records, in append order; a torn final line
+    (host died mid-append) is skipped, never fatal."""
+    if not os.path.exists(path):
+        return []
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail
+    except OSError:
+        return out
+    return out
+
+
+def latest_lease(path: str, *, tail_bytes: int = 8192) -> Optional[dict]:
+    """Newest decodable lease record — read from the file's TAIL only.
+
+    The supervisor polls this several times a second while heartbeats
+    append ~4 records/s/host indefinitely; re-parsing the whole stream
+    per poll would grow linearly with sweep age. Seeking to the last
+    ``tail_bytes`` and decoding backwards is O(1) per poll: the first
+    (possibly partial) tail line is skipped by the same torn-tolerant
+    decode that guards crash tears."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - tail_bytes))
+            chunk = f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return None
+    for line in reversed(chunk.splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn tail, or the seek landed mid-line
+    return None
+
+
+class Heartbeat:
+    """Per-host lease writer: one JSONL append every ``interval_s`` on a
+    daemon thread. ``suspend()`` freezes the beat (the WEDGE fault's
+    simulation of a stuck process); ``stop()`` writes a final record —
+    ``"left"`` for a clean exit, so the supervisor never classifies a
+    deliberate departure as a lost host."""
+
+    def __init__(
+        self,
+        run_dir: str,
+        slot: int,
+        *,
+        interval_s: float = 0.25,
+        world_epoch: int = 0,
+        world_size: int = 1,
+    ):
+        self.path = lease_path(run_dir, slot)
+        self.slot = int(slot)
+        self.interval_s = float(interval_s)
+        self.world_epoch = int(world_epoch)
+        self.world_size = int(world_size)
+        self._seq = 0
+        self._stop = threading.Event()
+        self._suspended = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _append(self, status: str) -> None:
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        rec = {
+            "slot": self.slot,
+            "pid": os.getpid(),
+            "hostname": socket.gethostname(),
+            "ts": time.time(),
+            "seq": self._seq,
+            "status": status,
+            "world_epoch": self.world_epoch,
+            "world_size": self.world_size,
+        }
+        self._seq += 1
+        # flush, no fsync: staleness detection tolerates losing the last
+        # beat (the NEXT one refreshes the lease), and an fsync every
+        # quarter-second would hammer a shared filesystem for nothing.
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if self._suspended.is_set():
+                continue
+            try:
+                self._append(ALIVE)
+            except OSError:
+                # A failing beat must never kill the trial thread's
+                # host; a persistently unwritable lease simply reads as
+                # lost — the honest verdict for a host that cannot
+                # reach the shared run dir.
+                pass
+
+    def start(self) -> "Heartbeat":
+        self._append(ALIVE)  # lease exists before the first interval
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=f"heartbeat:{self.slot}"
+        )
+        self._thread.start()
+        return self
+
+    def suspend(self) -> None:
+        """Freeze the beat without stopping the thread — the lease goes
+        stale like a wedged process's would."""
+        self._suspended.set()
+
+    def resume(self) -> None:
+        self._suspended.clear()
+
+    def stop(self, status: str = LEFT) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval_s + 1.0)
+            self._thread = None
+        try:
+            self._append(status)
+        except OSError:
+            pass
+
+
+# Process-wide current heartbeat: the fault injector's WEDGE kind (and
+# any drain path) needs to reach "this host's lease" without threading
+# the object through every seam.
+_current: Optional[Heartbeat] = None
+
+
+def start_heartbeat(
+    run_dir: str, slot: int, **kwargs
+) -> Heartbeat:
+    """Start (and register as current) this process's lease writer."""
+    global _current
+    if _current is not None:
+        _current.stop()
+    _current = Heartbeat(run_dir, slot, **kwargs).start()
+    return _current
+
+
+def current_heartbeat() -> Optional[Heartbeat]:
+    return _current
+
+
+def suspend_heartbeat() -> bool:
+    """Freeze the current heartbeat (WEDGE simulation); True if one was
+    running."""
+    if _current is None:
+        return False
+    _current.suspend()
+    return True
+
+
+def stop_heartbeat(status: str = LEFT) -> None:
+    global _current
+    if _current is not None:
+        _current.stop(status)
+        _current = None
+
+
+class MembershipView:
+    """Read-side membership: fold every host slot's lease stream into a
+    liveness verdict. Collective-free by construction — plain file
+    reads over the shared run dir."""
+
+    def __init__(self, run_dir: str):
+        self.run_dir = run_dir
+        self.dir = membership_dir(run_dir)
+        # host_lost telemetry fires on the stale TRANSITION only: a
+        # polling caller (deadline loop) must not emit one duplicate
+        # event per poll for a host that stays lost. A host that beats
+        # again (recovered lease) re-arms its transition.
+        self._reported_lost: set[int] = set()
+
+    def slots(self) -> list[int]:
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        out = []
+        for name in names:
+            if name.startswith(LEASE_PREFIX) and name.endswith(".jsonl"):
+                try:
+                    out.append(int(name[len(LEASE_PREFIX):-len(".jsonl")]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def hosts(self) -> dict[int, dict]:
+        """slot -> newest decodable lease record."""
+        out = {}
+        for slot in self.slots():
+            rec = latest_lease(lease_path(self.run_dir, slot))
+            if rec is not None:
+                out[slot] = rec
+        return out
+
+    def lost_hosts(
+        self,
+        deadline_s: float,
+        *,
+        now: Optional[float] = None,
+        among: Optional[list[int]] = None,
+    ) -> list[int]:
+        """Slots whose lease went stale: newest record older than
+        ``deadline_s`` and not a clean ``"left"``. ``among`` restricts
+        the check to the slots the caller believes should be beating
+        (the supervisor's currently-launched world) so long-departed
+        slots from earlier worlds don't re-report forever."""
+        t = time.time() if now is None else now
+        lost = []
+        for slot, rec in self.hosts().items():
+            if among is not None and slot not in among:
+                continue
+            if rec.get("status") == LEFT:
+                continue
+            if t - float(rec.get("ts", 0.0)) > deadline_s:
+                lost.append(slot)
+                if slot not in self._reported_lost:
+                    self._reported_lost.add(slot)
+                    emit_event(
+                        "host_lost",
+                        slot=slot,
+                        last_ts=rec.get("ts"),
+                        stale_s=round(t - float(rec.get("ts", 0.0)), 3),
+                        world_epoch=rec.get("world_epoch"),
+                    )
+            else:
+                self._reported_lost.discard(slot)
+        return sorted(lost)
+
+
+def record_world(
+    run_dir: str,
+    *,
+    epoch: int,
+    hosts: list[int],
+    lost: Optional[list[int]] = None,
+    reason: str = "",
+) -> dict:
+    """Append one world-formation record to ``membership/worlds.jsonl``
+    (torn-tail-tolerant like the leases). The durable world history:
+    workers read it on restart to compute which trials migrated, and
+    the drill report replays it for the shrink timeline."""
+    os.makedirs(membership_dir(run_dir), exist_ok=True)
+    rec = {
+        "epoch": int(epoch),
+        "hosts": sorted(int(h) for h in hosts),
+        "lost": sorted(int(h) for h in (lost or [])),
+        "reason": reason,
+        "ts": time.time(),
+    }
+    path = os.path.join(membership_dir(run_dir), WORLDS_NAME)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    if lost:
+        emit_event(
+            "world_shrunk",
+            epoch=rec["epoch"],
+            hosts=rec["hosts"],
+            lost=rec["lost"],
+            reason=reason,
+        )
+    return rec
+
+
+def world_history(run_dir: str) -> list[dict]:
+    """All decodable world records, in formation order."""
+    path = os.path.join(membership_dir(run_dir), WORLDS_NAME)
+    return read_lease(path)  # same torn-tail-tolerant JSONL fold

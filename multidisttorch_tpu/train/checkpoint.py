@@ -245,6 +245,123 @@ def verify_checkpoint(path: str) -> tuple[bool, Optional[dict], str]:
     return True, meta, "ok"
 
 
+def valid_candidates_by_step(
+    path: str,
+    *,
+    accept_meta: Optional[Callable[[dict], bool]] = None,
+) -> dict[int, tuple[str, dict]]:
+    """Locally-verifiable restore candidates keyed by their recorded
+    optimizer step: ``{step: (candidate_path, metadata)}``, newest
+    candidate winning a step collision.
+
+    The read side of the cross-host restore agreement
+    (``hpo/driver.py``): each owner process of a spanning submesh calls
+    this to learn which steps IT can verify (CRC + ``accept_meta``
+    gate), agrees on the min of the newest steps across owners
+    (``collectives.group_min_scalar``), then restores its candidate at
+    the agreed step. Candidates without a recorded ``step`` (pre-CRC
+    legacy sidecars) cannot participate in a step agreement and are
+    skipped. Rejections emit the same ``ckpt_scan_reject`` telemetry as
+    :func:`restore_latest_valid`.
+    """
+    from multidisttorch_tpu.telemetry.events import get_bus
+
+    bus = get_bus()
+    out: dict[int, tuple[str, dict]] = {}
+    for cand in checkpoint_candidates(path):
+        ok, meta, reason = verify_checkpoint(cand)
+        if not ok:
+            if bus is not None and reason != "missing":
+                bus.emit("ckpt_scan_reject", path=cand, reason=reason)
+            continue
+        meta = meta or {}
+        if accept_meta is not None and not accept_meta(meta):
+            if bus is not None:
+                bus.emit(
+                    "ckpt_scan_reject", path=cand, reason="meta rejected"
+                )
+            continue
+        if "step" not in meta:
+            continue  # legacy sidecar: no step to agree on
+        step = int(meta["step"])
+        if step not in out:  # candidates iterate newest-first
+            out[step] = (cand, meta)
+    return out
+
+
+def agreed_restore_step(
+    path: str,
+    *,
+    name: str,
+    participants,
+    accept_meta: Optional[Callable[[dict], bool]] = None,
+    timeout_s: Optional[float] = None,
+    what: str = "cross-host restore agreement",
+    **tags,
+) -> Optional[tuple[int, str, dict]]:
+    """The **cross-host restore agreement** (docs/RESILIENCE.md
+    "Elastic multi-host"): every participant process verifies its
+    restore candidates locally, the group agrees on the MIN of the
+    newest locally-valid steps, confirms every participant holds the
+    agreed candidate, and returns ``(step, candidate_path, metadata)``
+    — or ``None`` for "all resume from scratch".
+
+    Shared-filesystem views can disagree (NFS close-to-open races, a
+    write torn under one reader): without the agreement, owners of a
+    process-spanning submesh would restore different weights and
+    silently desynchronize SPMD. Any disagreement degrades to scratch
+    on EVERY participant, never an error — recovery must degrade, not
+    wedge.
+
+    The agreement rides the coordination-service sideband
+    (``cluster.agree_min_int``), NOT an on-mesh collective: it must
+    work during recovery, when the device world may be the broken
+    thing, and on backends without cross-process XLA computations.
+    ``name`` scopes the agreement's keys — callers make it unique per
+    (trial, attempt). A missing participant becomes a
+    ``WedgedCollective`` within ``timeout_s``. Extra ``tags`` ride the
+    emitted ``restore_agreement`` telemetry event.
+    """
+    from multidisttorch_tpu.parallel.cluster import agree_min_int
+    from multidisttorch_tpu.telemetry.events import get_bus
+
+    cands = valid_candidates_by_step(path, accept_meta=accept_meta)
+    local_best = max(cands) if cands else 0
+    agreed = agree_min_int(
+        f"mdt:restore:{name}:best",
+        local_best,
+        participants,
+        timeout_s=timeout_s,
+        what=f"{what} (best-step round)",
+    )
+    # Second round: min-over-bests guarantees agreed <= every local
+    # best, but not that every participant's valid SET contains it
+    # (retention skew). All hold the exact step, or all go scratch —
+    # and every participant reaches both rounds whatever its local
+    # verdict (uniform cadence).
+    have = 1 if (agreed > 0 and agreed in cands) else 0
+    all_have = agree_min_int(
+        f"mdt:restore:{name}:have",
+        have,
+        participants,
+        timeout_s=timeout_s,
+        what=f"{what} (availability round)",
+    )
+    bus = get_bus()
+    if bus is not None:
+        bus.emit(
+            "restore_agreement",
+            local_best_step=local_best,
+            agreed_step=agreed,
+            all_have=bool(all_have),
+            **tags,
+        )
+    if agreed <= 0 or not all_have:
+        return None
+    cand, meta = cands[agreed]
+    return agreed, cand, meta
+
+
 def restore_latest_valid(
     template: Any,
     path: str,

@@ -1,0 +1,66 @@
+"""Worker for the SIGTERM graceful-drain subprocess test.
+
+Runs a single-process sweep with per-epoch checkpoints; the parent
+test sends SIGTERM mid-sweep and asserts the exit-code contract
+(``cluster.PREEMPTION_EXIT_CODE``), then relaunches with ``resume`` to
+assert at most one checkpoint cadence of work was lost.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    out_dir = sys.argv[1]
+    resume = len(sys.argv) > 2 and sys.argv[2] == "resume"
+
+    from multidisttorch_tpu.data.datasets import synthetic_mnist
+    from multidisttorch_tpu.hpo.driver import TrialConfig, run_hpo
+    from multidisttorch_tpu.hpo.supervision import exit_code_for
+
+    train = synthetic_mnist(1024, seed=0)
+    cfgs = [
+        TrialConfig(
+            0, epochs=10, batch_size=32, hidden_dim=64, latent_dim=8,
+            seed=0, log_interval=10_000,
+        )
+    ]
+    try:
+        rs = run_hpo(
+            cfgs, train, None, num_groups=1, out_dir=out_dir,
+            verbose=False, save_images=False, save_checkpoints=True,
+            resume="scan" if resume else False,
+        )
+    except Exception as e:  # noqa: BLE001 — exit-code contract
+        print(f"DRAIN-EXIT {type(e).__name__}: {e}", flush=True)
+        return exit_code_for(e)
+    r = rs[0]
+    print(
+        "RESULT "
+        + json.dumps(
+            {
+                "status": r.status,
+                "steps": r.steps,
+                "resumed_from_step": r.resumed_from_step,
+            }
+        ),
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

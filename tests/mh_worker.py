@@ -353,6 +353,80 @@ def main():
             ),
         }
 
+    elif mode == "elastic_restore_agree":
+        # Cross-host restore agreement (docs/RESILIENCE.md "Elastic
+        # multi-host"), over a REAL multi-process world and a real
+        # keep-last checkpoint lineage. Process 1's VIEW of the newest
+        # checkpoint is torn (verification rejects it — the NFS
+        # close-to-open race, injected): the min-over-hosts agreement
+        # must pull BOTH processes to the earlier step everyone can
+        # verify; with healthy views both take the newest; with one
+        # host seeing nothing valid, both degrade to scratch; and a
+        # participant that never joins becomes a NAMED
+        # WedgedCollective within the deadline, never a hang.
+        import time as _time
+
+        import numpy as np
+
+        from multidisttorch_tpu.train import checkpoint as ckpt
+
+        path = os.path.join(out_dir, "trial-0", "state.msgpack")
+        if pid == 0:
+            state = {"w": np.arange(8, dtype=np.float32)}
+            ckpt.save_state(
+                state, path,
+                metadata={"step": 4, "completed_epochs": 1}, keep_last=3,
+            )
+            ckpt.save_state(
+                state, path,
+                metadata={"step": 8, "completed_epochs": 2}, keep_last=3,
+            )
+        mdt.sync_hosts("ckpts written", timeout_s=60)
+
+        real_verify = ckpt.verify_checkpoint
+
+        def set_verify(fn):
+            if pid == 1:
+                ckpt.verify_checkpoint = fn
+
+        def agree(name, timeout_s=20):
+            got = ckpt.agreed_restore_step(
+                path, name=name, participants=[0, 1], timeout_s=timeout_s
+            )
+            return got[0] if got is not None else None
+
+        summary = {"pid": pid}
+
+        def torn_newest(p):
+            ok, meta, reason = real_verify(p)
+            if ok and meta and int(meta.get("step", 0)) >= 8:
+                return False, meta, "simulated torn read (elastic test)"
+            return ok, meta, reason
+
+        set_verify(torn_newest)
+        summary["torn_agreed"] = agree("t0:a1")
+        set_verify(real_verify)
+        summary["healthy_agreed"] = agree("t0:a2")
+        set_verify(lambda p: (False, None, "all candidates torn"))
+        summary["none_agreed"] = agree("t0:a3")
+        set_verify(real_verify)
+        # No-hang contract: process 1 skips agreement a4 entirely.
+        if pid == 0:
+            from multidisttorch_tpu.parallel.cluster import (
+                WedgedCollective,
+            )
+
+            t0w = _time.time()
+            try:
+                agree("t0:a4", timeout_s=2)
+                summary["wedge"] = "no-error"
+            except WedgedCollective:
+                summary["wedge"] = "WedgedCollective"
+            summary["wedge_wait_s"] = round(_time.time() - t0w, 2)
+        else:
+            summary["wedge"] = "absent"
+        mdt.sync_hosts("restore agreement drill done", timeout_s=60)
+
     elif mode == "pbt":
         # Cross-process exploit moves weights via broadcast_one_to_all;
         # every process must report identical global decisions.

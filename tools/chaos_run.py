@@ -63,6 +63,38 @@ def main() -> int:
         "gate applies to the standard schedule only",
     )
     parser.add_argument(
+        "--multihost", action="store_true",
+        help="run the ELASTIC multi-host drill instead: N worker "
+        "processes under tools/sweep_supervisor.py, a host_lost/wedge "
+        "fault on one host mid-sweep, supervised world-shrink restart, "
+        "ledger-driven trial migration (docs/RESILIENCE.md \"Elastic "
+        "multi-host\")",
+    )
+    parser.add_argument("--mh-hosts", type=int, default=3)
+    parser.add_argument("--mh-devs-per-host", type=int, default=2)
+    parser.add_argument(
+        "--mh-kind", choices=("host_lost", "wedge"), default="host_lost",
+        help="the injected host fault: host_lost = instant os._exit "
+        "(SIGKILL semantics); wedge = the host stalls with its "
+        "heartbeat suspended and survivors must exit with a named "
+        "WedgedCollective within the watchdog deadline",
+    )
+    parser.add_argument("--mh-victim", type=int, default=1)
+    parser.add_argument(
+        "--mh-groups", default="per_host",
+        help="submesh carve for the drill: 'per_host' (default; "
+        "bit-parity applies, and the wedge surfaces at the bounded "
+        "end-of-sweep sideband barrier) or an integer group count "
+        "(e.g. 1 = one group spanning all hosts — needs a backend "
+        "with cross-process XLA computations, i.e. NOT the CPU "
+        "backend this tool forces)",
+    )
+    parser.add_argument(
+        "--mh-agree-timeout", type=float, default=15.0,
+        help="MDT_AGREE_TIMEOUT_S for the workers: the wedge-watchdog "
+        "deadline the WedgedCollective exit is asserted against",
+    )
+    parser.add_argument(
         "--telemetry-dir", default=None,
         help="write the chaos run's telemetry (events.jsonl, Perfetto "
         "trace.json, metrics.prom, summary.json) here instead of "
@@ -82,9 +114,68 @@ def main() -> int:
         )
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-    from multidisttorch_tpu.faults.harness import run_chaos_bench
-
     work_dir = args.work_dir or tempfile.mkdtemp(prefix="chaos_run_")
+
+    if args.multihost:
+        from multidisttorch_tpu.faults.harness import run_chaos_mh_bench
+
+        report = run_chaos_mh_bench(
+            work_dir,
+            hosts=args.mh_hosts,
+            devs_per_host=args.mh_devs_per_host,
+            trials=args.trials,
+            epochs=args.epochs,
+            kind=args.mh_kind,
+            victim=args.mh_victim,
+            groups_mode=args.mh_groups,
+            agree_timeout_s=args.mh_agree_timeout,
+            # Wedge: the survivors' bounded end-of-sweep barrier must
+            # trip (the asserted WedgedCollective exit) BEFORE the
+            # supervisor's staleness verdict — so the lease deadline is
+            # deliberately lazy for that kind.
+            heartbeat_deadline_s=45.0 if args.mh_kind == "wedge" else 3.0,
+        )
+        ok = (
+            report["all_trials_settled"]
+            and report["goodput"] >= 0.8
+            and report["worlds_formed"] >= 2
+            and report["hosts_lost"] == [args.mh_victim]
+            and (
+                report["recovered_bit_identical"] in (True, None)
+            )
+            # membership telemetry: the shrink is a traced, typed story
+            and report["membership"]["host_lost_traced"]
+            and report["membership"]["world_shrunk_traced"]
+            # the watchdog acceptance: a wedge must surface as a NAMED
+            # WedgedCollective exit, never a silent hang/timeout
+            and (
+                args.mh_kind != "wedge"
+                or report["wedged_collective_exits"] >= 1
+            )
+        )
+        headline = {
+            "metric": "chaos_mh_goodput_useful_over_executed_steps",
+            "value": report["goodput"],
+            "unit": "fraction",
+            "vs_baseline": round(report["goodput"] / 0.8, 3),
+            "kind": args.mh_kind,
+            "hosts": f"{args.mh_hosts}->{report['hosts_final']}",
+            "all_trials_settled": report["all_trials_settled"],
+            "recovered_bit_identical": report["recovered_bit_identical"],
+            "wedged_collective_exits": report["wedged_collective_exits"],
+            "detail": report,
+        }
+        print(json.dumps(headline))
+        if args.out:
+            os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+            tmp = args.out + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(headline, f, indent=2)
+            os.replace(tmp, args.out)
+            print(f"report written to {args.out}", file=sys.stderr)
+        return 0 if ok else 1
+
+    from multidisttorch_tpu.faults.harness import run_chaos_bench
 
     plan = None
     if args.plan is not None:

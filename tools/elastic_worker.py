@@ -1,0 +1,230 @@
+#!/usr/bin/env python
+"""Elastic worker: one host process of a supervised multi-host sweep.
+
+Launched (and relaunched, in shrinking worlds) by
+``tools/sweep_supervisor.py``; also driven directly by the multihost
+elastic tests. Environment contract (see the supervisor's docstring):
+``OMPI_COMM_WORLD_SIZE/RANK`` + ``MASTER_ADDR/PORT`` (the framework's
+own launcher detection), ``MH_DEVS_PER_PROC``, ``MDT_HOST_SLOT`` (the
+stable host identity across worlds), ``MDT_WORLD_EPOCH``, and
+``MDT_ELASTIC_RUN_DIR``.
+
+The worker:
+
+1. starts the sideband heartbeat (``parallel/membership.py``) — the
+   supervisor's collective-free liveness signal;
+2. arms the fault injector with this host's slot and a durable
+   fired-log, so host-scoped faults (``host_lost``/``wedge``) stay
+   one-shot across world restarts;
+3. runs the chaos sweep with full supervision, ``resume="scan"`` on
+   any world after the first (ledger skips settled trials; in-flight
+   trials restore via the agreed scan-back), and submeshes re-carved
+   over the CURRENT, possibly smaller, device world;
+4. emits ``trial_migrated`` telemetry for trials whose submesh
+   assignment changed vs the previous world;
+5. dies by the exit-code contract: 0 = sweep complete here,
+   ``cluster.PREEMPTION_EXIT_CODE`` = healthy host, lost world
+   (preemption / WedgedCollective / drain), anything else = this host
+   is suspect.
+"""
+
+import json
+import os
+import sys
+import traceback
+
+_DEVS_PER_PROC = int(os.environ.get("MH_DEVS_PER_PROC", "2"))
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={_DEVS_PER_PROC}"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    run_dir = os.environ.get("MDT_ELASTIC_RUN_DIR") or sys.argv[2]
+    mode = sys.argv[1] if len(sys.argv) > 1 else "chaos_sweep"
+    slot = int(os.environ.get("MDT_HOST_SLOT", "0"))
+    world_epoch = int(os.environ.get("MDT_WORLD_EPOCH", "0"))
+    trials = int(os.environ.get("MDT_MH_TRIALS", "6"))
+    epochs = int(os.environ.get("MDT_MH_EPOCHS", "3"))
+    data_rows = int(os.environ.get("MDT_MH_DATA_ROWS", "128"))
+    groups_mode = os.environ.get("MDT_MH_GROUPS", "per_host")
+
+    import multidisttorch_tpu as mdt
+    from multidisttorch_tpu import telemetry
+    from multidisttorch_tpu.data.datasets import synthetic_mnist
+    from multidisttorch_tpu.faults.inject import FaultInjector
+    from multidisttorch_tpu.faults.plan import FaultPlan
+    from multidisttorch_tpu.hpo.driver import run_hpo
+    from multidisttorch_tpu.hpo.supervision import (
+        RetryPolicy,
+        exit_code_for,
+    )
+    from multidisttorch_tpu.parallel import membership
+    from multidisttorch_tpu.telemetry.events import get_bus
+
+    nproc, pid = mdt.initialize_runtime()
+    assert nproc == int(os.environ["OMPI_COMM_WORLD_SIZE"]), (
+        nproc, os.environ["OMPI_COMM_WORLD_SIZE"],
+    )
+    # Sideband liveness: the lease file is keyed by the STABLE slot, so
+    # a host keeps one identity across shrinking worlds.
+    membership.start_heartbeat(
+        run_dir,
+        slot,
+        interval_s=float(os.environ.get("MDT_HEARTBEAT_INTERVAL_S", "0.25")),
+        world_epoch=world_epoch,
+        world_size=nproc,
+    )
+    # Per-process telemetry sink under the shared run dir (PR 3's
+    # multi-controller naming), one subdir per WORLD: ranks renumber
+    # across worlds and the sink truncates on open, so world k+1's
+    # rank 0 must not clobber world k's stream. The drill reads the
+    # union of every world's files.
+    telemetry.configure(
+        os.path.join(run_dir, "telemetry", f"w{world_epoch}")
+    )
+
+    configs = None
+    injector = None
+    plan_path = os.path.join(run_dir, "fault_plan.json")
+    if os.path.exists(plan_path):
+        with open(plan_path) as f:
+            plan = FaultPlan.from_json(f.read())
+        injector = FaultInjector(
+            plan,
+            host_slot=slot,
+            fired_log=os.path.join(
+                membership.membership_dir(run_dir), f"fired-{slot}.jsonl"
+            ),
+        )
+
+    if mode == "chaos_sweep":
+        from multidisttorch_tpu.faults.harness import standard_configs
+
+        configs = standard_configs(trials, epochs)
+    else:
+        raise SystemExit(f"unknown elastic worker mode {mode!r}")
+
+    num_groups = (
+        jax.process_count()
+        if groups_mode == "per_host"
+        else int(groups_mode)
+    )
+
+    train = synthetic_mnist(data_rows, seed=0)
+
+    # Trial-migration telemetry: compare the previous world's
+    # deterministic assignment with this one's.
+    if world_epoch > 0:
+        from multidisttorch_tpu.hpo.driver import (
+            balanced_assignment,
+            predicted_cost,
+        )
+        from multidisttorch_tpu.parallel.membership import world_history
+
+        prev_worlds = [
+            w
+            for w in world_history(run_dir)
+            if w.get("epoch") == world_epoch - 1
+        ]
+        if prev_worlds and len(prev_worlds[-1].get("hosts", [])) >= 1:
+            costs = [predicted_cost(cfg, data_rows) for cfg in configs]
+            old_n = (
+                len(prev_worlds[-1]["hosts"])
+                if groups_mode == "per_host"
+                else num_groups
+            )
+            old = balanced_assignment(costs, max(1, old_n))
+            new = balanced_assignment(costs, max(1, num_groups))
+            bus = get_bus()
+            if bus is not None:
+                for cfg, g_old, g_new in zip(configs, old, new):
+                    if g_old != g_new:
+                        bus.emit(
+                            "trial_migrated",
+                            trial_id=cfg.trial_id,
+                            from_group=g_old,
+                            to_group=g_new,
+                            world_epoch=world_epoch,
+                        )
+
+    try:
+        results = run_hpo(
+            configs,
+            train,
+            None,
+            num_groups=num_groups,
+            out_dir=run_dir,
+            verbose=False,
+            save_images=False,
+            save_checkpoints=True,
+            ckpt_keep_last=3,
+            resilient=True,
+            retry=RetryPolicy(max_retries=2, backoff_base_s=0.01,
+                              jitter=True, jitter_seed=0),
+            fault_plan=injector,
+            resume="scan" if world_epoch > 0 else False,
+            ledger=True,
+        )
+        # End-of-sweep collection barrier (bounded: MDT_SYNC_TIMEOUT_S)
+        # — the drill's wedge surface: a host stalled mid-sweep leaves
+        # its peers here, and the watchdog converts the wait into a
+        # named WedgedCollective instead of a hang.
+        mdt.sync_hosts("elastic sweep end")
+    except Exception as e:  # noqa: BLE001 — exit-code contract
+        from multidisttorch_tpu.parallel.cluster import (
+            PREEMPTION_EXIT_CODE,
+        )
+
+        code = exit_code_for(e)
+        preempted = code == PREEMPTION_EXIT_CODE
+        print(
+            f"PREEMPTED {type(e).__name__}: {e}"
+            if preempted
+            else f"WORKER-ERROR {type(e).__name__}: {e}",
+            flush=True,
+        )
+        if not preempted:
+            traceback.print_exc()
+        membership.stop_heartbeat()
+        telemetry.disable()
+        return code
+
+    summary = {
+        "pid": pid,
+        "slot": slot,
+        "world_epoch": world_epoch,
+        "world_size": nproc,
+        "trials": {
+            r.trial_id: {
+                "status": r.status,
+                "steps": r.steps,
+                "resumed_from_step": r.resumed_from_step,
+                "final_train_loss": r.final_train_loss,
+                "attempt": r.attempt,
+                "group_id": r.group_id,
+            }
+            for r in results
+        },
+    }
+    out_path = os.path.join(run_dir, f"results-h{slot}-w{world_epoch}.json")
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(summary, f, indent=2)
+    os.replace(tmp, out_path)
+    print("RESULT " + json.dumps(summary), flush=True)
+    membership.stop_heartbeat()
+    telemetry.disable()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

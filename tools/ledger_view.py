@@ -52,8 +52,8 @@ def fold(events: list[dict]) -> dict[str, dict]:
     out: dict[str, dict] = {}
     for ev in events:
         h = ev.get("config_hash")
-        if not h:
-            continue
+        if not h or ev.get("event") not in ("attempt_start", "attempt_end"):
+            continue  # compaction summaries etc. carry no attempt row
         rec = out.setdefault(
             h, {"trial_id": ev.get("trial_id"), "attempts": {}}
         )
@@ -137,11 +137,33 @@ def main(argv=None) -> int:
         "attempt history, settled/in-flight) instead of the rendered "
         "table — for CI and scripts",
     )
+    parser.add_argument(
+        "--compact", action="store_true",
+        help="atomically rewrite the ledger to its minimal equivalent "
+        "state first (SweepLedger.compact: latest attempt_start/_end "
+        "per config hash + a summary record carrying the attempt and "
+        "infra-failure counters) — restart storms grow the attempt "
+        "history without bound; this caps it. Torn-tail safe; the "
+        "restart folds (settled-skip, attempt numbering, retry "
+        "budgets) are provably unchanged",
+    )
     args = parser.parse_args(argv)
     path = resolve_ledger_path(args.path)
     if not os.path.exists(path):
         print(f"no ledger at {path}", file=sys.stderr)
         return 1
+    if args.compact:
+        from multidisttorch_tpu.hpo.ledger import SweepLedger
+
+        led = SweepLedger(os.path.dirname(path) or ".", enabled=True)
+        led.path = path
+        stats = led.compact()
+        print(
+            f"compacted {path}: {stats['lines_before']} -> "
+            f"{stats['lines_after']} lines over {stats['hashes']} "
+            "configs",
+            file=sys.stderr,
+        )
     events = load_ledger(path)
     folded = fold(events)
     if args.json:
