@@ -579,19 +579,63 @@ def run_chaos_mh_bench(
                 wedged_exits += 1
 
     # Membership telemetry union: every world's worker sinks plus the
-    # supervisor's, folded for the traced-events cross-check.
+    # supervisor's, folded for the traced-events cross-check. The
+    # supervisor already exported the merged fleet artifacts on its way
+    # out (telemetry/fleet/) — that dir is the merge's OUTPUT, so it is
+    # excluded here or every event would count twice.
+    from multidisttorch_tpu.telemetry import fleet as fleet_mod
     from multidisttorch_tpu.telemetry.events import read_events
 
-    tel_root = os.path.join(run_dir, "telemetry")
     tel_events = []
-    for dirpath, _dirs, names in os.walk(tel_root):
-        for name in names:
-            if name.endswith(".jsonl"):
-                tel_events.extend(read_events(os.path.join(dirpath, name)))
+    for shard in fleet_mod.discover_shards(run_dir):
+        tel_events.extend(read_events(shard))
     kinds = {}
     for ev in tel_events:
         k = str(ev.get("kind", ""))
         kinds[k] = kinds.get(k, 0) + 1
+
+    # --- fleet artifact gates (ISSUE 6) -----------------------------
+    # The drill's observability acceptance: ONE merged, skew-corrected
+    # timeline spanning every host and world, with the injected fault,
+    # the shrink, the migration lineage, and a non-null restart-tax
+    # breakdown all present in fleet_summary.json. Re-export here only
+    # if the supervisor's own export failed (it is best-effort there).
+    fleet_paths = sup_report.get("fleet")
+    if not fleet_paths or "error" in fleet_paths:
+        fleet_paths = fleet_mod.export_fleet(run_dir)["paths"]
+    with open(fleet_paths["summary"]) as f:
+        fleet_summary = json.load(f)
+    tax = fleet_summary.get("restart_tax") or []
+    # Non-null breakdown: every transition carries its three live
+    # phases; restore is evidence-joined from the worker streams and
+    # must be present for at least one transition (the re-formed world
+    # restores from checkpoint by construction of this drill).
+    restart_tax_nonnull = bool(tax) and all(
+        t.get("detect_s") is not None
+        and t.get("drain_s") is not None
+        and t.get("relaunch_s") is not None
+        for t in tax
+    ) and any(t.get("restore_s") is not None for t in tax)
+    # fleet.migrated_trials is the one authority on what counts as a
+    # migration; the summary carries its verdict
+    migrated_in_lineage = len(fleet_summary.get("migrated_trials") or [])
+    fleet_block = {
+        "paths": fleet_paths,
+        "all_hosts_traced": fleet_summary.get("all_hosts_traced"),
+        "hosts_seen": fleet_summary.get("hosts_seen"),
+        "worlds_in_timeline": len(fleet_summary.get("worlds") or []),
+        "world_shrunk_traced": fleet_summary.get("world_shrunk_traced"),
+        "all_faults_traced": (
+            fleet_summary.get("faults", {}).get("all_faults_traced")
+        ),
+        "faults_fired": fleet_summary.get("faults", {}).get("fired"),
+        "restart_tax": tax,
+        "restart_tax_nonnull": restart_tax_nonnull,
+        "migrated_trials_in_lineage": migrated_in_lineage,
+        "torn_lines_total": fleet_summary.get("torn_lines_total"),
+        "goodput": fleet_summary.get("goodput"),
+        "skew": fleet_summary.get("skew"),
+    }
 
     all_settled = all(
         merged.get(cfg.trial_id, {}).get("status") in settled
@@ -636,6 +680,7 @@ def run_chaos_mh_bench(
             "world_shrunk_traced": kinds.get("world_shrunk", 0) > 0,
             "trials_migrated_traced": kinds.get("trial_migrated", 0),
         },
+        "fleet": fleet_block,
         "supervisor": sup_report,
         "run_dir": run_dir,
     }

@@ -2715,6 +2715,16 @@ def _run_hpo_body(
 
     bus = get_bus()
     if bus is not None:
+        # Fleet identity rides the sweep header too (not just the
+        # per-event tags): the console's one-line summary of a merged
+        # stream needs "whose sweep_start is this" without scanning
+        # tags. Only stamped when tagged — an untagged single-host
+        # stream must stay byte-identical.
+        fleet_id = {}
+        if bus.host is not None:
+            fleet_id["host_slot"] = bus.host
+        if bus.world is not None:
+            fleet_id["world_epoch"] = bus.world
         bus.emit(
             "sweep_start",
             configs=len(configs),
@@ -2723,6 +2733,7 @@ def _run_hpo_body(
             resume=bool(resume),
             resilient=bool(resilient),
             skipped_settled=len(skipped),
+            **fleet_id,
         )
 
     def drain_now():

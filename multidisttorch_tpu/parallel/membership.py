@@ -146,6 +146,12 @@ class Heartbeat:
             "pid": os.getpid(),
             "hostname": socket.gethostname(),
             "ts": time.time(),
+            # Paired monotonic anchor: a reader comparing consecutive
+            # (ts, mono) deltas can tell a wall-clock STEP (NTP jump,
+            # operator date change) from real elapsed time — the fleet
+            # merge (telemetry/fleet.py) uses the pairs as its per-host
+            # clock-sanity evidence.
+            "mono": time.monotonic(),
             "seq": self._seq,
             "status": status,
             "world_epoch": self.world_epoch,

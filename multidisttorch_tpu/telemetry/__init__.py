@@ -68,6 +68,8 @@ def configure(
     device_sample_every: int = 100,
     anomaly: Optional["AnomalyConfig"] = None,
     anomaly_capture_dir: Optional[str] = None,
+    host: Optional[int] = None,
+    world: Optional[int] = None,
 ) -> None:
     """Turn telemetry ON: create the event bus (JSONL sink under
     ``out_dir`` when given, in-memory only otherwise), the metrics
@@ -75,7 +77,10 @@ def configure(
     ``anomaly_capture_dir=`` additionally arms the bounded profiler
     capture — off by default, since only one profiler session can
     exist per process), and install the best-effort compile
-    listener."""
+    listener. ``host``/``world`` are the fleet identity tags stamped
+    on every event (default from ``MDT_HOST_SLOT``/``MDT_WORLD_EPOCH``
+    — see ``telemetry/fleet.py``; unset means an untagged single-host
+    stream)."""
     path = None
     if out_dir is not None:
         os.makedirs(out_dir, exist_ok=True)
@@ -85,12 +90,35 @@ def configure(
         # shared filesystem — independent handles on ONE file would
         # interleave and overwrite each other's bytes. One sink per
         # process; tools read the per-process streams individually.
-        import jax
+        # Identity must never come from jax.process_count() — that
+        # initializes the backend, and the elastic supervisor and the
+        # preflight CLI configure telemetry precisely to diagnose a
+        # backend that would wedge that call. Instead: an ALREADY
+        # initialized jax.distributed (covers explicit
+        # initialize(coordinator, num_processes=...) launches with no
+        # launcher env — reading global_state initializes nothing),
+        # else the launcher env (the same source jax.distributed
+        # auto-initializes from).
+        num_processes, process_id = 1, 0
+        try:
+            from jax._src import distributed as _jdist
 
-        if jax.process_count() > 1:
-            name = f"events.p{jax.process_index()}.jsonl"
+            if getattr(_jdist.global_state, "client", None) is not None:
+                num_processes = _jdist.global_state.num_processes or 1
+                process_id = _jdist.global_state.process_id or 0
+        except Exception:
+            pass
+        if num_processes <= 1:
+            from multidisttorch_tpu.parallel.cluster import (
+                detect_process_env,
+            )
+
+            penv = detect_process_env()
+            num_processes, process_id = penv.num_processes, penv.process_id
+        if num_processes > 1:
+            name = f"events.p{process_id}.jsonl"
         path = os.path.join(out_dir, name)
-    _events.configure(path=path, queue_max=queue_max)
+    _events.configure(path=path, queue_max=queue_max, host=host, world=world)
     _metrics.configure(device_sample_every=device_sample_every)
     if anomaly_capture_dir is not None:
         import dataclasses

@@ -99,6 +99,23 @@ def fmt_table(
     return "\n".join(out)
 
 
+def host_health(
+    status: Optional[str],
+    age_s: Optional[float],
+    deadline_s: float = 3.0,
+) -> str:
+    """One-word host liveness verdict for the fleet console, from the
+    newest lease record's status + age — the same staleness rule the
+    supervisor applies (``membership.MembershipView.lost_hosts``)."""
+    if status == "left":
+        return "left"
+    if status == "draining":
+        return "drain"
+    if age_s is None:
+        return "?"
+    return "STALE" if age_s > deadline_s else "up"
+
+
 def clear_screen() -> str:
     """ANSI clear+home, for the --follow refresh loop."""
     return "\x1b[2J\x1b[H"

@@ -32,11 +32,21 @@ is one global read — no :class:`Event` is ever constructed
 Thread-safety: ``emit`` takes a lock — the driver's scheduling loop is
 single-threaded, but checkpoint writes emit from the background writer
 thread (``hpo/driver.py``'s ``_write_ckpt``).
+
+Fleet identity: in a multi-host sweep every shard must say WHO wrote
+it, or the cross-host merge (``telemetry/fleet.py``) cannot attribute a
+line to a host after the process that wrote it is gone. The identity is
+**bus-level**, stamped once at :func:`configure` (``host`` = the stable
+host slot, ``world`` = the elastic world epoch; both default from the
+supervisor-provided ``MDT_HOST_SLOT`` / ``MDT_WORLD_EPOCH`` env) and
+applied to every event at emit. Single-host streams stay byte-stable:
+an unset tag is never serialized (tests/test_fleet.py enforces this).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from collections import deque
@@ -50,7 +60,9 @@ EVENTS_NAME = "events.jsonl"
 class Event:
     """One telemetry event. ``kind`` is the taxonomy key
     (docs/OBSERVABILITY.md); identity tags are ``None`` when the
-    emitting seam doesn't know them."""
+    emitting seam doesn't know them. ``host``/``world`` are the fleet
+    tags (stable host slot, elastic world epoch) stamped by the bus —
+    never set per-emit."""
 
     kind: str
     ts: float
@@ -59,11 +71,16 @@ class Event:
     attempt: Optional[int] = None
     step: Optional[int] = None
     group_id: Optional[int] = None
+    host: Optional[int] = None
+    world: Optional[int] = None
     data: dict = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         d = {"kind": self.kind, "ts": self.ts}
-        for k in ("trial_id", "lane", "attempt", "step", "group_id"):
+        for k in (
+            "trial_id", "lane", "attempt", "step", "group_id",
+            "host", "world",
+        ):
             v = getattr(self, k)
             if v is not None:
                 d[k] = v
@@ -72,14 +89,37 @@ class Event:
         return d
 
 
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None
+
+
 class Bus:
     """The process-local event bus (construct via :func:`configure`)."""
 
-    def __init__(self, path: Optional[str] = None, queue_max: int = 4096):
+    def __init__(
+        self,
+        path: Optional[str] = None,
+        queue_max: int = 4096,
+        *,
+        host: Optional[int] = None,
+        world: Optional[int] = None,
+    ):
         if queue_max < 1:
             raise ValueError(f"queue_max must be >= 1, got {queue_max}")
         self.path = path
         self.queue_max = queue_max
+        # Fleet identity (host slot / world epoch): stamped on every
+        # event this bus emits. None = single-host stream — the tags
+        # are then never serialized, keeping the stream byte-identical
+        # to a pre-fleet one.
+        self.host = host
+        self.world = world
         self.dropped = 0
         self.emitted = 0
         self._recent: deque[Event] = deque()
@@ -121,6 +161,8 @@ class Bus:
                 attempt=attempt,
                 step=step,
                 group_id=group_id,
+                host=self.host,
+                world=self.world,
                 data=data,
             )
             self.emitted += 1
@@ -169,12 +211,27 @@ def get_bus() -> Optional[Bus]:
     return _bus
 
 
-def configure(path: Optional[str] = None, *, queue_max: int = 4096) -> Bus:
-    """Install a fresh bus (closing any previous one)."""
+def configure(
+    path: Optional[str] = None,
+    *,
+    queue_max: int = 4096,
+    host: Optional[int] = None,
+    world: Optional[int] = None,
+) -> Bus:
+    """Install a fresh bus (closing any previous one). ``host``/``world``
+    are the fleet identity tags; when not given they default from the
+    elastic supervisor's worker environment (``MDT_HOST_SLOT`` /
+    ``MDT_WORLD_EPOCH``) so any process launched into a world is tagged
+    without its seams knowing about fleets. Absent both, events carry
+    no tags at all (single-host byte-stability)."""
     global _bus
     if _bus is not None:
         _bus.close()
-    _bus = Bus(path=path, queue_max=queue_max)
+    if host is None:
+        host = _env_int("MDT_HOST_SLOT")
+    if world is None:
+        world = _env_int("MDT_WORLD_EPOCH")
+    _bus = Bus(path=path, queue_max=queue_max, host=host, world=world)
     return _bus
 
 
@@ -185,15 +242,17 @@ def disable() -> None:
     _bus = None
 
 
-def read_events(path: str) -> list[dict]:
-    """All decodable events from a JSONL sink, in append order. A torn
-    final line (crash mid-append) is skipped, not fatal — the same
-    contract as :meth:`hpo.ledger.SweepLedger.load`."""
+def read_events_counting(path: str) -> tuple[list[dict], int]:
+    """All decodable events from a JSONL sink, in append order, plus
+    the count of skipped undecodable (torn/garbled) lines. The ONE
+    torn-tolerant reader — the fleet merge reports the count, plain
+    readers drop it."""
     events: list[dict] = []
+    torn = 0
     try:
         f = open(path)
     except OSError:
-        return events
+        return events, torn
     with f:
         for line in f:
             line = line.strip()
@@ -202,7 +261,17 @@ def read_events(path: str) -> list[dict]:
             try:
                 ev = json.loads(line)
             except json.JSONDecodeError:
-                continue  # torn tail
+                torn += 1
+                continue
             if isinstance(ev, dict):
                 events.append(ev)
-    return events
+            else:
+                torn += 1
+    return events, torn
+
+
+def read_events(path: str) -> list[dict]:
+    """All decodable events from a JSONL sink, in append order. A torn
+    final line (crash mid-append) is skipped, not fatal — the same
+    contract as :meth:`hpo.ledger.SweepLedger.load`."""
+    return read_events_counting(path)[0]

@@ -45,45 +45,80 @@ def _tid(ev: dict) -> int:
     return _DRIVER_TID if t is None else int(t) + 1
 
 
-def build_trace(events: list[dict]) -> dict:
-    """Chrome ``trace_event`` JSON (dict form) from an event stream."""
-    if events:
-        t0 = min(float(ev.get("ts", 0.0)) for ev in events)
-    else:
-        t0 = 0.0
+def build_trace(
+    events: list[dict],
+    *,
+    pid_for=None,
+    process_names: Optional[dict] = None,
+    t0: Optional[float] = None,
+) -> dict:
+    """Chrome ``trace_event`` JSON (dict form) from an event stream.
+
+    By default everything rides one process (``pid 1``, "sweep") — the
+    single-host shape, byte-stable vs pre-fleet traces. The fleet
+    exporter (``telemetry/fleet.py``) passes ``pid_for`` (event -> pid,
+    one process track per host) plus ``process_names`` (pid -> display
+    name) and an explicit ``t0`` so world spans that precede the first
+    event still land at non-negative trace time."""
+    if t0 is None:
+        if events:
+            t0 = min(float(ev.get("ts", 0.0)) for ev in events)
+        else:
+            t0 = 0.0
 
     def us(ts: float) -> float:
         return round((ts - t0) * 1e6, 1)
 
-    out: list[dict] = [
-        {
-            "name": "process_name",
-            "ph": "M",
-            "pid": 1,
-            "args": {"name": "sweep"},
-        },
-        {
-            "name": "thread_name",
-            "ph": "M",
-            "pid": 1,
-            "tid": _DRIVER_TID,
-            "args": {"name": "driver"},
-        },
-    ]
-    named_tids = set()
-    # attempt spans: (trial_id, attempt) -> start event
+    if pid_for is None:
+        pid_for = lambda ev: 1  # noqa: E731 — the single-process default
+    names = {1: "sweep"} if process_names is None else dict(process_names)
+    out: list[dict] = []
+    named_pids: set = set()
+    named_tids: set = set()
+
+    def ensure_pid(pid: int) -> None:
+        if pid in named_pids:
+            return
+        named_pids.add(pid)
+        out.append(
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": pid,
+                "args": {"name": names.get(pid, f"process {pid}")},
+            }
+        )
+        out.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": _DRIVER_TID,
+                "args": {"name": "driver"},
+            }
+        )
+
+    # Declared processes come first (supervisor track, every known
+    # host) so the trace names them even when a host emitted nothing.
+    for pid in sorted(names):
+        ensure_pid(pid)
+    if not names:
+        ensure_pid(1)
+    # attempt spans: (pid, trial_id, attempt) -> start event
     open_attempts: dict[tuple, dict] = {}
     for ev in sorted(events, key=lambda e: float(e.get("ts", 0.0))):
         kind = ev.get("kind", "?")
         ts = float(ev.get("ts", 0.0))
         tid = _tid(ev)
-        if tid != _DRIVER_TID and tid not in named_tids:
-            named_tids.add(tid)
+        pid = pid_for(ev)
+        ensure_pid(pid)
+        if tid != _DRIVER_TID and (pid, tid) not in named_tids:
+            named_tids.add((pid, tid))
             out.append(
                 {
                     "name": "thread_name",
                     "ph": "M",
-                    "pid": 1,
+                    "pid": pid,
                     "tid": tid,
                     "args": {"name": f"trial {tid - 1}"},
                 }
@@ -109,17 +144,17 @@ def build_trace(events: list[dict]) -> dict:
                     {
                         "name": f"device_memory[{data.get('key', '?')}]",
                         "ph": "C",
-                        "pid": 1,
+                        "pid": pid,
                         "ts": us(ts),
                         "args": series,
                     }
                 )
             continue
         if kind == "attempt_start":
-            open_attempts[(ev.get("trial_id"), ev.get("attempt"))] = ev
+            open_attempts[(pid, ev.get("trial_id"), ev.get("attempt"))] = ev
             continue
         if kind == "attempt_end":
-            key = (ev.get("trial_id"), ev.get("attempt"))
+            key = (pid, ev.get("trial_id"), ev.get("attempt"))
             start = open_attempts.pop(key, None)
             status = (ev.get("data") or {}).get("status", "?")
             begin = float(start["ts"]) if start else ts
@@ -128,7 +163,7 @@ def build_trace(events: list[dict]) -> dict:
                     "name": f"attempt {ev.get('attempt')} -> {status}",
                     "cat": "attempt",
                     "ph": "X",
-                    "pid": 1,
+                    "pid": pid,
                     "tid": tid,
                     "ts": us(begin),
                     "dur": max(0.0, us(ts) - us(begin)),
@@ -142,7 +177,7 @@ def build_trace(events: list[dict]) -> dict:
                 "cat": kind.split("_")[0],
                 "ph": "i",
                 "s": "t",
-                "pid": 1,
+                "pid": pid,
                 "tid": tid,
                 "ts": us(ts),
                 "args": args,
@@ -150,13 +185,13 @@ def build_trace(events: list[dict]) -> dict:
         )
     # A crash can leave attempts open (e.g. preemption): render what we
     # know as zero-duration spans so the work still appears.
-    for (trial_id, attempt), start in open_attempts.items():
+    for (pid, trial_id, attempt), start in open_attempts.items():
         out.append(
             {
                 "name": f"attempt {attempt} -> (unclosed)",
                 "cat": "attempt",
                 "ph": "X",
-                "pid": 1,
+                "pid": pid,
                 "tid": _tid(start),
                 "ts": us(float(start["ts"])),
                 "dur": 0.0,
@@ -261,12 +296,25 @@ class SweepFold:
         self.last_ts: Optional[float] = None
         self.useful = 0
         self.executed = 0
+        # Goodput bookkeeping for streams where an attempt can die
+        # WITHOUT an attempt_end (host_lost has SIGKILL semantics in a
+        # merged fleet stream): per-trial step coverage so a killed
+        # attempt's executed prefix — visible only as the next
+        # attempt's resume point — still lands in `executed`, and
+        # attempt_end echoes (one per controller in a merged
+        # multi-controller stream) are counted once.
+        self._covered: dict[int, int] = {}
+        self._ended: set[tuple[int, int, str]] = set()
         self.done = False
         # Device books folded off device_cost / device_memory events,
         # keyed by step-series key ("trial-3" / "bucket-g0") — the live
         # console's copy of what the registry holds in-process.
         self.device: dict[str, dict] = {}
         self.anomalies = 0
+        # Fleet tags (host slot -> event count) — empty on an untagged
+        # single-host stream; the fleet console folds a merged stream
+        # through the same class.
+        self.hosts: dict[int, int] = {}
 
     def _trial(self, tid: int) -> dict:
         return self.trials.setdefault(
@@ -286,6 +334,8 @@ class SweepFold:
                 "anomalies": 0,
                 "first_ts": None,
                 "last_ts": None,
+                "host": None,
+                "world": None,
             },
         )
 
@@ -331,8 +381,13 @@ class SweepFold:
                     book["memory_source"] = data.get("source")
         if kind.startswith("anomaly_"):
             self.anomalies += 1
+        if ev.get("host") is not None:
+            h = int(ev["host"])
+            self.hosts[h] = self.hosts.get(h, 0) + 1
         tid = ev.get("trial_id")
-        if tid is None:
+        if tid is None or int(tid) < 0:
+            # trial_id=-1 is the host-scoped fault sentinel
+            # (faults/plan.py) — not a trial, so no table row.
             return
         t = self._trial(int(tid))
         t["last_ts"] = ts
@@ -342,19 +397,37 @@ class SweepFold:
             t["lane"] = ev["lane"]
         if ev.get("group_id") is not None:
             t["group"] = ev["group_id"]
+        if ev.get("host") is not None:
+            t["host"] = ev["host"]
+        if ev.get("world") is not None:
+            t["world"] = ev["world"]
         data = ev.get("data") or {}
         if kind == "attempt_start":
             t["attempts"] = max(t["attempts"], int(ev.get("attempt") or 0))
             t["status"] = "in_flight"
         elif kind == "attempt_end":
             status = data.get("status", "?")
+            key = (int(tid), int(ev.get("attempt") or 0), status)
+            if key in self._ended:
+                return
+            self._ended.add(key)
             t["status"] = status
             if status == "retrying":
                 t["retries"] += 1
             s = data.get("summary") or {}
             done = int(s.get("steps", s.get("steps_at_failure", 0)) or 0)
             resumed = int(s.get("resumed_from_step", 0) or 0)
-            self.executed += max(0, done - resumed)
+            # `useful` counts a settled trial's full cumulative steps
+            # (a recovered prefix WAS useful), so `executed` must cover
+            # [0, done) at least once or goodput can read > 1: beyond
+            # this attempt's own work, count any prefix executed by an
+            # attempt that never reported (killed without attempt_end —
+            # its work is visible only as this resume point).
+            covered = self._covered.get(int(tid), 0)
+            self.executed += max(0, done - resumed) + max(
+                0, resumed - covered
+            )
+            self._covered[int(tid)] = max(covered, done)
             if status in SETTLED_STATUSES:
                 self.useful += done
         elif kind == "epoch":

@@ -152,6 +152,16 @@ def main() -> int:
                 args.mh_kind != "wedge"
                 or report["wedged_collective_exits"] >= 1
             )
+            # fleet observability gates (ISSUE 6, docs/OBSERVABILITY.md
+            # "Fleet"): the merged timeline spans every host, every
+            # fired fault appears in it, and the world transition has a
+            # non-null restart-tax breakdown. faults_fired >= 1 keeps
+            # the cross-check honest: all_faults_traced over an empty
+            # (missing/unreadable) fired-log is vacuously true.
+            and report["fleet"]["all_hosts_traced"]
+            and report["fleet"]["faults_fired"] >= 1
+            and report["fleet"]["all_faults_traced"]
+            and report["fleet"]["restart_tax_nonnull"]
         )
         headline = {
             "metric": "chaos_mh_goodput_useful_over_executed_steps",
@@ -163,6 +173,9 @@ def main() -> int:
             "all_trials_settled": report["all_trials_settled"],
             "recovered_bit_identical": report["recovered_bit_identical"],
             "wedged_collective_exits": report["wedged_collective_exits"],
+            "all_hosts_traced": report["fleet"]["all_hosts_traced"],
+            "all_faults_traced": report["fleet"]["all_faults_traced"],
+            "restart_tax_nonnull": report["fleet"]["restart_tax_nonnull"],
             "detail": report,
         }
         print(json.dumps(headline))

@@ -86,10 +86,16 @@ def main() -> int:
     # Per-process telemetry sink under the shared run dir (PR 3's
     # multi-controller naming), one subdir per WORLD: ranks renumber
     # across worlds and the sink truncates on open, so world k+1's
-    # rank 0 must not clobber world k's stream. The drill reads the
-    # union of every world's files.
+    # rank 0 must not clobber world k's stream. The fleet merge
+    # (telemetry/fleet.py) folds the union of every world's files —
+    # the explicit host/world identity here is what lets it attribute
+    # this shard's lines after this process is gone (the env default
+    # would resolve identically; explicit beats implicit for the one
+    # tag the whole fleet story hangs off).
     telemetry.configure(
-        os.path.join(run_dir, "telemetry", f"w{world_epoch}")
+        os.path.join(run_dir, "telemetry", f"w{world_epoch}"),
+        host=slot,
+        world=world_epoch,
     )
 
     configs = None

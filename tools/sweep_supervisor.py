@@ -27,6 +27,22 @@ three framework contracts (docs/RESILIENCE.md "Elastic multi-host"):
   (``SweepLedger.compact``) so restart storms don't grow the ledger
   without bound.
 
+The supervisor is also a **telemetry emitter** (docs/OBSERVABILITY.md
+"Fleet"): it opens its own event stream under
+``{run_dir}/telemetry/sup`` (unless the caller already configured
+one), emits ``world_start``/``world_end`` around every world it forms,
+and measures the **restart tax** of every shrink live — ``detect``
+(the victim's last heartbeat → the supervisor's trigger), ``drain``
+(teardown of the old world), ``relaunch`` (the replacement world
+spawned) — as a ``restart_tax`` event the fleet merge completes with
+the restore/first-useful-step phases it can only see in the workers'
+streams. Before forming the first world it can run the backend
+**preflight** (``utils/preflight.py``): a wedged backend then aborts
+the launch with a classified verdict instead of wedging N workers. On
+exit it folds every shard into the merged fleet artifacts
+(``telemetry/fleet/``: merged events + Perfetto fleet trace +
+``fleet_summary.json``).
+
 Worker environment per world (the framework's own OpenMPI-style
 detection, ``parallel/cluster.py``): ``OMPI_COMM_WORLD_SIZE/RANK``
 over the SURVIVING slots, a fresh ``MASTER_PORT`` per world (no
@@ -92,6 +108,10 @@ class ElasticSupervisor:
         env_extra: Optional[dict] = None,
         compact_ledger: bool = True,
         log_dir: Optional[str] = None,
+        preflight: bool = False,
+        preflight_platform: Optional[str] = None,
+        preflight_timeout_s: float = 60.0,
+        export_fleet: bool = True,
     ):
         self.worker_argv = list(worker_argv)
         self.run_dir = run_dir
@@ -106,8 +126,15 @@ class ElasticSupervisor:
         self.env_extra = dict(env_extra or {})
         self.compact_ledger = compact_ledger
         self.log_dir = log_dir or os.path.join(run_dir, "logs")
+        self.preflight = preflight
+        self.preflight_platform = preflight_platform
+        self.preflight_timeout_s = float(preflight_timeout_s)
+        self.export_fleet = export_fleet
         self.view = MembershipView(run_dir)
         self.worlds: list[dict] = []  # report timeline
+        self.restart_taxes: list[dict] = []  # live-measured phases
+        self.preflight_report: Optional[dict] = None
+        self.fleet: Optional[dict] = None  # exported artifact paths
 
     # -- world lifecycle ---------------------------------------------
 
@@ -245,11 +272,67 @@ class ElasticSupervisor:
         except Exception as e:  # noqa: BLE001 — compaction is best-effort
             return {"error": f"{type(e).__name__}: {e}"}
 
+    def _run_preflight(self) -> None:
+        """Probe the backend BEFORE forming a world: a wedged backend
+        (ROADMAP item 5, the banked BENCH_r04/r05 shape) becomes a
+        classified, skippable abort instead of N workers hanging into
+        the boot grace. Emits ``preflight_*`` telemetry."""
+        from multidisttorch_tpu.utils.preflight import run_preflight
+
+        t = int(self.preflight_timeout_s)
+        report = run_preflight(
+            self.preflight_platform,
+            init_timeout_s=t,
+            retry_timeout_s=max(1, t // 2),
+            canary_timeout_s=t,
+        )
+        self.preflight_report = report
+        if not report["usable"]:
+            raise RuntimeError(
+                "supervisor: backend preflight verdict "
+                f"{report['verdict']!r} ({report['verdict_reason']}) — "
+                "refusing to form a world on a diagnosed-bad backend"
+            )
+
     # -- the loop -----------------------------------------------------
 
     def run(self) -> dict:
+        """Supervise the sweep. Opens a supervisor telemetry stream
+        (``{run_dir}/telemetry/sup``) unless one is already configured,
+        and ALWAYS lands the merged fleet artifacts on the way out —
+        a failed sweep needs its fleet story more than a clean one."""
+        from multidisttorch_tpu import telemetry as _telemetry
+
+        own_telemetry = not _telemetry.enabled()
+        if own_telemetry:
+            _telemetry.configure(
+                os.path.join(self.run_dir, "telemetry", "sup")
+            )
+        report = None
+        try:
+            report = self._run()
+            return report
+        finally:
+            if self.export_fleet:
+                try:
+                    from multidisttorch_tpu.telemetry.fleet import (
+                        export_fleet,
+                    )
+
+                    self.fleet = export_fleet(self.run_dir)["paths"]
+                except Exception as e:  # noqa: BLE001 — best-effort
+                    self.fleet = {"error": f"{type(e).__name__}: {e}"}
+                if report is not None:
+                    report["fleet"] = self.fleet
+            if own_telemetry:
+                _telemetry.disable()
+
+    def _run(self) -> dict:
+        if self.preflight:
+            self._run_preflight()
         slots = list(range(self.nhosts))
         epoch = 0
+        pending_tax: Optional[dict] = None
         while True:
             if epoch >= self.max_worlds:
                 raise RuntimeError(
@@ -260,6 +343,19 @@ class ElasticSupervisor:
             if epoch == 0:
                 record_world(self.run_dir, epoch=0, hosts=slots)
             procs = self._launch_world(epoch, slots)
+            emit_event("world_start", epoch=epoch, hosts=list(slots))
+            if pending_tax is not None:
+                # Relaunch phase closes the moment the replacement
+                # world's processes exist; the restore / first-useful-
+                # step phases live in the WORKERS' streams — the fleet
+                # merge (telemetry/fleet.py) joins them onto this event.
+                pending_tax["relaunch_s"] = round(
+                    time.time() - pending_tax.pop("_teardown_done"), 3
+                )
+                pending_tax["world_epoch"] = epoch
+                emit_event("restart_tax", **pending_tax)
+                self.restart_taxes.append(pending_tax)
+                pending_tax = None
             trigger = None
             while trigger is None:
                 self._poll_exits(procs)
@@ -291,6 +387,15 @@ class ElasticSupervisor:
                 else:
                     time.sleep(self.poll_s)
             kind, lost_now = trigger
+            emit_event(
+                "world_end",
+                epoch=epoch,
+                outcome=kind,
+                exits={
+                    str(s): i["exit"] for s, i in sorted(procs.items())
+                },
+                wall_s=round(time.time() - t0, 3),
+            )
             if kind == "complete":
                 self.worlds.append(
                     {
@@ -325,8 +430,31 @@ class ElasticSupervisor:
                     "failing — a sync escaped its watchdog"
                 )
             # host_lost or preempted: tear down, classify, re-form.
+            # Restart-tax detect phase: the gap between the last
+            # heartbeat any lost host managed and THIS trigger moment —
+            # how long the fault existed before the supervisor saw it.
+            trigger_ts = time.time()
+            leases = self.view.hosts()
+            victim_beats = [
+                float(leases[s].get("ts", 0.0))
+                for s in lost_now
+                if s in leases
+            ]
+            detect_s = (
+                round(trigger_ts - max(victim_beats), 3)
+                if victim_beats
+                else 0.0
+            )
             stale = self._stale_slots(procs, epoch)
+            drain_t0 = time.time()
             self._shutdown_world(procs)
+            pending_tax = {
+                "trigger": kind,
+                "lost": sorted(lost_now),
+                "detect_s": detect_s,
+                "drain_s": round(time.time() - drain_t0, 3),
+                "_teardown_done": time.time(),
+            }
             verdict = self._classify(procs, sorted(set(lost_now) | set(stale)))
             for slot in verdict["lost"]:
                 emit_event(
@@ -378,6 +506,8 @@ class ElasticSupervisor:
             "hosts_initial": self.nhosts,
             "hosts_final": len(self.worlds[-1]["hosts"]),
             "hosts_lost": all_lost,
+            "restart_tax": self.restart_taxes,
+            "preflight": self.preflight_report,
             "run_dir": self.run_dir,
             "log_dir": self.log_dir,
         }
@@ -398,6 +528,25 @@ def main() -> int:
         "--no-compact", action="store_true",
         help="skip ledger compaction between worlds",
     )
+    parser.add_argument(
+        "--preflight", action="store_true",
+        help="run the classified backend preflight (tools/preflight.py "
+        "taxonomy) before forming the first world; a non-usable "
+        "verdict aborts the launch instead of wedging N workers",
+    )
+    parser.add_argument(
+        "--preflight-platform", default=None,
+        help="platform the preflight probes (default: default backend)",
+    )
+    parser.add_argument(
+        "--preflight-timeout", type=float, default=60.0,
+        help="per-stage preflight deadline in seconds",
+    )
+    parser.add_argument(
+        "--no-fleet", action="store_true",
+        help="skip merging the fleet artifacts "
+        "(telemetry/fleet/) on exit",
+    )
     parser.add_argument("worker", nargs=argparse.REMAINDER,
                         help="worker argv (prefix with --)")
     args = parser.parse_args()
@@ -415,6 +564,10 @@ def main() -> int:
         max_worlds=args.max_worlds,
         world_timeout_s=args.world_timeout,
         compact_ledger=not args.no_compact,
+        preflight=args.preflight,
+        preflight_platform=args.preflight_platform,
+        preflight_timeout_s=args.preflight_timeout,
+        export_fleet=not args.no_fleet,
     )
     report = sup.run()
     print(json.dumps(report, indent=2))

@@ -3,12 +3,21 @@
 per-trial status, step rates, retries, and sweep goodput.
 
     python tools/sweep_top.py <telemetry-dir-or-events.jsonl> [--follow]
+    python tools/sweep_top.py <run-dir> --fleet [--follow]
 
 Works on a LIVE run (``--follow`` re-reads new lines each interval and
 redraws — the sink is flushed per event, so a running sweep streams)
 or on a finished one (one-shot render). It only reads the JSONL — it
 never initializes a jax backend or touches the accelerator, so it can
 run next to a live sweep.
+
+``--fleet`` turns the console into the FLEET view over an elastic
+multi-host run directory (docs/OBSERVABILITY.md "Fleet"): every
+per-host/per-world shard under ``{run_dir}/telemetry`` is merged on
+the skew-corrected fleet clock (``telemetry/fleet.py``) and the render
+adds per-host health (lease age vs the heartbeat deadline), the world
+history with its shrink reasons, the restart-tax breakdown of every
+world transition, and each migrated trial's lineage across worlds.
 
 Enable telemetry on the sweep side with ``MDT_TELEMETRY=1
 MDT_TELEMETRY_DIR=<dir>`` or ``telemetry.telemetry_run(<dir>)`` — see
@@ -34,6 +43,7 @@ from multidisttorch_tpu.telemetry.console import (  # noqa: E402
     fmt_rate,
     fmt_table,
     fmt_ts,
+    host_health,
     status_glyph,
 )
 from multidisttorch_tpu.telemetry.events import EVENTS_NAME  # noqa: E402
@@ -155,6 +165,165 @@ def render(state: SweepFold, path: str) -> str:
     return "\n".join(lines)
 
 
+def fleet_state(run_dir: str) -> tuple[SweepFold, dict, bool]:
+    """Merge the run's shards on the fleet clock and fold them: the
+    SAME SweepFold the single-stream console uses (so the trial table
+    reads identically), the fleet summary (hosts, worlds, tax,
+    lineage), and the FLEET-level done verdict. A merged stream holds
+    one sweep_end per controller, so the single-stream ``state.done``
+    flips on the FIRST finished host while others still train — under
+    a supervisor, done means the final world ended complete; without
+    one (no world events), the single-stream flag is all there is."""
+    from multidisttorch_tpu.telemetry import fleet as _fleet
+
+    merged = _fleet.merge_fleet(run_dir)
+    summary = _fleet.fleet_summary(run_dir, merged=merged)
+    state = SweepFold()
+    supervised = done = False
+    for ev in merged["events"]:
+        state.feed(ev)
+        if ev.get("kind") == "world_end":
+            supervised = True
+            if (ev.get("data") or {}).get("outcome") == "complete":
+                done = True
+        elif ev.get("kind") == "world_start":
+            supervised = True
+            done = False  # a new world reopens the sweep
+    return state, summary, (done if supervised else state.done)
+
+
+def render_fleet(
+    state: SweepFold,
+    summary: dict,
+    run_dir: str,
+    *,
+    deadline_s: float = 3.0,
+) -> str:
+    lines = [
+        f"sweep_top --fleet  {run_dir}",
+        "events {events}  shards {shards}  torn {torn}  "
+        "worlds {worlds}  goodput {gp}".format(
+            events=summary["events"],
+            shards=len(summary["shards"]),
+            torn=summary["torn_lines_total"],
+            worlds=len(summary["worlds"]),
+            gp=(
+                f"{summary['goodput']:.3f}"
+                if summary["goodput"] is not None
+                else "-"
+            ),
+        ),
+        "",
+        "hosts",
+    ]
+    rows = []
+    import time as _time
+
+    now = _time.time()
+    for slot_s, h in sorted(
+        summary["hosts"].items(), key=lambda kv: int(kv[0])
+    ):
+        # Age from the corrected lease timestamp at RENDER time — the
+        # follow loop renders a cached summary between shard changes,
+        # and a dead fleet (no shard ever changes again) must still age
+        # toward STALE on screen. lease_age_s is the build-time value
+        # kept for --json consumers.
+        if h.get("lease_ts_fleet") is not None:
+            age = round(now - h["lease_ts_fleet"], 3)
+        else:
+            age = h.get("lease_age_s")
+        skew = (summary["skew"].get(slot_s) or {}).get(
+            "applied_offset_s", 0.0
+        )
+        rows.append(
+            [
+                slot_s,
+                host_health(h.get("lease_status"), age, deadline_s),
+                fmt_duration(age) if age is not None else "-",
+                h["events"],
+                ",".join(str(w) for w in h.get("worlds", [])) or "-",
+                fmt_duration(
+                    (h["last_ts"] - h["first_ts"])
+                    if h.get("first_ts") is not None
+                    else None
+                ),
+                fmt_duration(now - h["last_ts"])
+                if h.get("last_ts")
+                else "-",
+                f"{skew:+.3f}s" if skew else "-",
+            ]
+        )
+    lines.append(
+        fmt_table(
+            rows,
+            ["host", "health", "lease age", "events", "worlds", "span",
+             "quiet", "skew"],
+            indent="  ",
+        )
+    )
+    lines.extend(["", "worlds"])
+    wrows = [
+        [
+            w.get("epoch"),
+            ",".join(str(h) for h in w.get("hosts", [])),
+            ",".join(str(h) for h in w.get("lost", [])) or "-",
+            w.get("reason") or "-",
+            fmt_ts(w.get("ts")),
+        ]
+        for w in summary["worlds"]
+    ]
+    lines.append(
+        fmt_table(
+            wrows, ["epoch", "hosts", "lost", "reason", "formed"],
+            indent="  ",
+        )
+    )
+    if summary["restart_tax"]:
+        lines.extend(["", "restart tax (per world transition)"])
+        trows = []
+        for t in summary["restart_tax"]:
+            trows.append(
+                [
+                    t.get("world_epoch"),
+                    t.get("trigger") or "-",
+                    ",".join(str(h) for h in (t.get("lost") or [])) or "-",
+                    fmt_duration(t.get("detect_s")),
+                    fmt_duration(t.get("drain_s")),
+                    fmt_duration(t.get("relaunch_s")),
+                    fmt_duration(t.get("restore_s")),
+                    fmt_duration(t.get("first_useful_step_s")),
+                    fmt_duration(t.get("total_s")),
+                ]
+            )
+        lines.append(
+            fmt_table(
+                trows,
+                ["world", "trigger", "lost", "detect", "drain",
+                 "relaunch", "restore", "first step", "total"],
+                indent="  ",
+            )
+        )
+    # fleet.migrated_trials (via the summary) is the one authority on
+    # what counts as a migration vs mere lineage
+    migrated = {
+        tid: summary["lineage"][tid]
+        for tid in summary.get("migrated_trials", [])
+        if tid in summary["lineage"]
+    }
+    if migrated:
+        lines.extend(["", "trial lineage (migrated trials)"])
+        for tid, chain in sorted(migrated.items(), key=lambda kv: int(kv[0])):
+            lines.append(
+                f"  trial {tid}: "
+                + " -> ".join(
+                    f"w{c['world']}@h{c['host']}" for c in chain
+                )
+            )
+    lines.append("")
+    lines.append(render(state, run_dir))
+    return "\n".join(lines)
+
+
 def follow_lines(path: str, state: SweepFold, offset: int) -> int:
     """Feed decodable complete lines past ``offset``; returns the new
     offset. A torn tail (no trailing newline yet) is left for the next
@@ -190,11 +359,25 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "path",
-        help="telemetry dir (containing events.jsonl) or the JSONL file",
+        help="telemetry dir (containing events.jsonl) or the JSONL "
+        "file; with --fleet, the elastic RUN dir (containing "
+        "telemetry/ and membership/)",
     )
     parser.add_argument(
         "-f", "--follow", action="store_true",
         help="keep tailing and redraw every --interval seconds",
+    )
+    parser.add_argument(
+        "--fleet", action="store_true",
+        help="fleet view over an elastic multi-host run dir: merge "
+        "every per-host/per-world shard on the skew-corrected fleet "
+        "clock and add host health, world history, restart tax, and "
+        "migration lineage (docs/OBSERVABILITY.md \"Fleet\")",
+    )
+    parser.add_argument(
+        "--deadline", type=float, default=3.0,
+        help="heartbeat staleness (s) behind the fleet view's host "
+        "health verdict — match the supervisor's --heartbeat-deadline",
     )
     parser.add_argument(
         "--json", action="store_true",
@@ -211,6 +394,87 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.json and args.follow:
         parser.error("--json is one-shot; it cannot combine with --follow")
+
+    if args.fleet:
+        if not os.path.isdir(args.path):
+            print(f"--fleet expects a run directory, got {args.path}",
+                  file=sys.stderr)
+            return 1
+
+        def one_shot():
+            state, summary, _done = fleet_state(args.path)
+            if args.json:
+                # The machine-readable fleet snapshot: the summary
+                # (hosts/worlds/tax/lineage) plus the same per-trial
+                # fold the single-stream --json emits.
+                summary = dict(summary)
+                summary["trials"] = {
+                    k: state.trials[k] for k in sorted(state.trials)
+                }
+                print(json.dumps(summary, default=str))
+            else:
+                print(
+                    render_fleet(
+                        state, summary, args.path,
+                        deadline_s=args.deadline,
+                    )
+                )
+            return state
+
+        if not args.follow:
+            one_shot()
+            return 0
+
+        def fleet_sig():
+            # Cheap change detector for the follow loop: (path, size,
+            # mtime) of every shard plus the membership sideband. The
+            # merge itself is O(total events) — append-only shards
+            # mean an unchanged signature makes a re-merge pure waste,
+            # so idle refreshes only re-render (lease ages still age).
+            from multidisttorch_tpu.telemetry import fleet as _fleet
+
+            paths = _fleet.discover_shards(args.path)
+            mdir = os.path.join(args.path, "membership")
+            if os.path.isdir(mdir):
+                paths = paths + [
+                    os.path.join(mdir, n) for n in sorted(os.listdir(mdir))
+                ]
+            sig = []
+            for p in paths:
+                try:
+                    st = os.stat(p)
+                    sig.append((p, st.st_size, st.st_mtime))
+                except OSError:
+                    sig.append((p, -1, -1.0))
+            return tuple(sig)
+
+        refreshes = 0
+        state = summary = None
+        fleet_done = False
+        last_sig = None
+        try:
+            while True:
+                sig = fleet_sig()
+                if state is None or sig != last_sig:
+                    state, summary, fleet_done = fleet_state(args.path)
+                    last_sig = sig
+                print(
+                    clear_screen()
+                    + render_fleet(
+                        state, summary, args.path,
+                        deadline_s=args.deadline,
+                    ),
+                    flush=True,
+                )
+                refreshes += 1
+                if fleet_done:
+                    break
+                if args.max_refreshes and refreshes >= args.max_refreshes:
+                    break
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            pass
+        return 0
 
     path = resolve_events_path(args.path)
     if not os.path.exists(path) and not args.follow:
