@@ -1,0 +1,42 @@
+"""The compile subsystem: kill the compile tax (ROADMAP item 2).
+
+Every new shape bucket used to pay the full ``lower→compile`` on the
+driver's hot path, and the persistent XLA cache had been disabled since
+PR 1 (deserialized XLA:CPU executables corrupt the heap on the pinned
+jaxlib). Three layers re-attack it:
+
+- :mod:`~multidisttorch_tpu.compile.registry` +
+  :mod:`~multidisttorch_tpu.compile.programs` — a process-lifetime
+  **executable registry** keyed by the program vocabulary (shape
+  bucket + baked scalar hypers + submesh devices). One compile per
+  program, ever; coalesced; timed; shared with the cost books.
+- :mod:`~multidisttorch_tpu.compile.farm` — the **background AOT
+  precompile farm**: ``run_hpo(precompile=True)`` (or
+  ``MDT_PRECOMPILE=1``) walks the sweep's pending configs at entry and
+  compiles every bucket's programs on worker threads, so trial
+  admission never blocks the host loop on XLA.
+- :mod:`~multidisttorch_tpu.compile.cache` — the **quarantined
+  persistent cache**: CRC32 sidecars + a subprocess canary-execute
+  protocol gate jax's on-disk executable cache; TPU enables after a
+  passed canary, XLA:CPU stays quarantined-only (sacrificial
+  processes excepted).
+- :mod:`~multidisttorch_tpu.compile.coldstart` — the **cold-start
+  books' benchmark**: ``bench.py --coldstart`` measures cold vs
+  precompiled vs cache-warm admission latency with a bit-parity gate.
+
+See docs/COMPILE.md for the safety model and protocols.
+"""
+
+from multidisttorch_tpu.compile import programs  # noqa: F401
+from multidisttorch_tpu.compile.cache import (  # noqa: F401
+    cache_probe,
+    canary_quarantine,
+    enable_quarantined_cache,
+    scan_cache,
+    seal_cache,
+)
+from multidisttorch_tpu.compile.farm import PrecompilePool  # noqa: F401
+from multidisttorch_tpu.compile.registry import (  # noqa: F401
+    ExecutableRegistry,
+    get_executable_registry,
+)

@@ -1,0 +1,280 @@
+"""Background AOT precompile farm: shape buckets compile off the hot path.
+
+At ``run_hpo`` entry the driver knows every pending ``TrialConfig`` —
+which means it knows every distinct train program the sweep will ever
+compile (the shape-bucket key plus the single-path scalar hypers, see
+:mod:`~multidisttorch_tpu.compile.programs`). The farm walks that plan
+ONCE, derives each work item's programs for its *predicted* submesh
+(the driver's initial queue order assigns item *j* to local group
+``j % n_groups``; a mispredicted placement is just a registry miss —
+the admission path compiles inline and the executable still lands in
+the registry for the next same-program trial on that group), and
+compiles them on worker threads via the registry's one compile routine.
+XLA releases the GIL during compilation, so N workers genuinely overlap
+— and overlap with the first trials' *training*, which is the whole
+point: by the time submesh g finishes trial k, trial k+1's program is
+already an executable.
+
+Admission therefore **never blocks the host loop on XLA** when the farm
+is on: a trial whose program is still ``COMPILING`` waits
+*cooperatively* (its generator yields, other submeshes keep stepping);
+a trial whose program the farm has not started yet ``claim()``s it and
+compiles inline (exactly the pre-farm behavior, with books).
+
+Torn-shutdown safety: ``shutdown()`` flips a flag workers check between
+jobs — queued jobs are dropped, the in-flight compile (daemon thread)
+finishes into the registry harmlessly, and nothing the driver holds is
+invalidated. ``run_hpo`` shuts the farm down on every exit path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Callable, Optional, Sequence
+
+from multidisttorch_tpu.compile import programs as _programs
+from multidisttorch_tpu.compile.registry import (
+    PENDING,
+    SOURCE_PRECOMPILE,
+    ExecutableRegistry,
+    get_executable_registry,
+)
+from multidisttorch_tpu.telemetry.events import get_bus
+from multidisttorch_tpu.telemetry.metrics import get_registry as _metrics
+
+
+def default_workers() -> int:
+    env = os.environ.get("MDT_PRECOMPILE_WORKERS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return max(1, min(4, (os.cpu_count() or 2) - 1))
+
+
+def _emit(kind: str, **data) -> None:
+    bus = get_bus()
+    if bus is not None:
+        bus.emit(kind, **data)
+
+
+class PrecompilePool:
+    """Worker threads draining a deque of (key, builder) compile jobs
+    into the executable registry."""
+
+    def __init__(
+        self,
+        registry: Optional[ExecutableRegistry] = None,
+        workers: Optional[int] = None,
+    ):
+        self.registry = registry or get_executable_registry()
+        self.workers = workers or default_workers()
+        self._jobs: deque = deque()
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._threads: list[threading.Thread] = []
+        self._shutdown = False
+        self._in_flight = 0
+        self.submitted = 0
+
+    # -- job intake ---------------------------------------------------
+
+    def submit(self, key: tuple, builder: Callable[[], tuple]) -> bool:
+        """Queue one program: ``builder() -> (jit_fn, avals)`` runs on
+        the worker (step-factory construction is itself host work worth
+        keeping off the driver loop). Deduped on the registry entry —
+        a program already scheduled/compiled/claimed is skipped."""
+        if not self.registry.schedule(key):
+            return False
+        with self._lock:
+            if self._shutdown:
+                # Un-schedule: the entry just created would otherwise
+                # sit PENDING forever (shutdown's release loop only
+                # covers jobs that made it into the queue) and stall a
+                # later admission on this key for the full wait.
+                self.registry.release(key)
+                return False
+            self._jobs.append((key, builder))
+            self.submitted += 1
+            self._wake.notify()
+            self._ensure_workers()
+        _emit(
+            "precompile_scheduled",
+            program=_programs.program_label(key),
+            program_kind=key[0],
+        )
+        return True
+
+    def plan_sweep(
+        self,
+        items: Sequence[tuple],
+        groups: Sequence,
+        *,
+        max_lanes: int = 8,
+    ) -> int:
+        """Derive and submit the whole sweep's compile jobs from the
+        driver's work items (``("single"|"bucket", [(i, cfg), ...])``),
+        predicting item *j*'s submesh as ``groups[j % len(groups)]``
+        (the driver's initial pop order). Primary programs (the one the
+        first dispatch needs — multi when fused, else train) are queued
+        before tail/secondary programs so the farm's first finished
+        executables are the ones admissions are waiting on."""
+        from multidisttorch_tpu.hpo.driver import stack_bucket_key
+
+        if not groups:
+            return 0
+        primary: list[tuple] = []
+        secondary: list[tuple] = []
+        for j, (kind, members) in enumerate(items):
+            g = groups[j % len(groups)]
+            cfg = members[0][1]
+            bucket = stack_bucket_key(cfg)
+            if kind == "bucket":
+                lanes = min(len(members), max_lanes)
+                tkey = _programs.stacked_train_key(g, bucket, lanes)
+                mkey = _programs.stacked_multi_key(g, bucket, lanes)
+
+                def sbuilder(which, g=g, cfg=cfg, lanes=lanes):
+                    steps = _programs.build_stacked_steps(g, cfg)
+                    avals = _programs.stacked_avals(cfg, lanes)
+                    return steps[which], avals[which]
+
+                if cfg.fused_steps > 1:
+                    primary.append((mkey, lambda b=sbuilder: b("multi")))
+                    secondary.append((tkey, lambda b=sbuilder: b("train")))
+                else:
+                    primary.append((tkey, lambda b=sbuilder: b("train")))
+            else:
+                tkey = _programs.single_train_key(g, cfg, bucket)
+                mkey = _programs.single_multi_key(g, cfg, bucket)
+
+                def builder(which, g=g, cfg=cfg):
+                    steps = _programs.build_single_steps(g, cfg)
+                    avals = _programs.single_avals(cfg)
+                    return steps[which], avals[which]
+
+                # The state-init program sits on the admission path
+                # BEFORE the train program (``_TrialRun.__init__``
+                # materializes state, then run() admits the steps), so
+                # it is queued immediately ahead of the item's primary
+                # — the worker finishes them in consumption order.
+                ikey = _programs.single_init_key(g, cfg, bucket)
+                primary.append((
+                    ikey,
+                    lambda cfg=cfg: (
+                        _programs.build_init_fn(cfg),
+                        _programs.init_avals(),
+                    ),
+                ))
+                if cfg.fused_steps > 1:
+                    primary.append((mkey, lambda b=builder: b("multi")))
+                    secondary.append((tkey, lambda b=builder: b("train")))
+                else:
+                    primary.append((tkey, lambda b=builder: b("train")))
+        n = 0
+        for key, builder in primary + secondary:
+            if self.submit(key, builder):
+                n += 1
+        _emit("precompile_plan", jobs=n, items=len(items))
+        reg = _metrics()
+        if reg is not None:
+            reg.counter("precompile_jobs").inc(n)
+        return n
+
+    # -- workers ------------------------------------------------------
+
+    def _ensure_workers(self) -> None:
+        # under self._lock
+        while len(self._threads) < min(self.workers, len(self._jobs) or 1):
+            t = threading.Thread(
+                target=self._worker,
+                name=f"mdt-precompile-{len(self._threads)}",
+                daemon=True,
+            )
+            self._threads.append(t)
+            t.start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._lock:
+                while not self._jobs and not self._shutdown:
+                    self._wake.wait(timeout=1.0)
+                if self._shutdown and not self._jobs:
+                    return
+                if not self._jobs:
+                    continue
+                key, builder = self._jobs.popleft()
+                self._in_flight += 1
+            try:
+                # A driver admission may have claimed the job (or an
+                # identical-signature twin already compiled it) while
+                # it sat queued — skip, don't duplicate the XLA work.
+                if self.registry.status(key) != PENDING:
+                    _emit(
+                        "precompile_skipped",
+                        program=_programs.program_label(key),
+                    )
+                    continue
+                try:
+                    fn, avals = builder()
+                except Exception as e:  # noqa: BLE001 — a broken builder
+                    # must not kill the worker; marking the entry FAILED
+                    # (never leaving it PENDING) releases any admission
+                    # cooperatively waiting on it to the jit fallback.
+                    err = f"{type(e).__name__}: {e}"[:300]
+                    self.registry.fail(key, err)
+                    _emit(
+                        "precompile_failed",
+                        program=_programs.program_label(key),
+                        error=err,
+                    )
+                    continue
+                self.registry.compile_now(
+                    key, fn, avals, source=SOURCE_PRECOMPILE
+                )
+            finally:
+                with self._lock:
+                    self._in_flight -= 1
+                    self._wake.notify_all()
+
+    # -- lifecycle ----------------------------------------------------
+
+    def shutdown(self, wait: bool = False, timeout_s: float = 30.0) -> None:
+        """Stop accepting and drop queued jobs. ``wait=True`` joins the
+        in-flight compiles (bounded); the default leaves them to finish
+        into the registry on their daemon threads — torn shutdown is
+        safe by construction (the registry entry either becomes READY
+        for a future sweep in this process, or stays COMPILING in a
+        table nobody consults again)."""
+        with self._lock:
+            self._shutdown = True
+            dropped_jobs = list(self._jobs)
+            self._jobs.clear()
+            self._wake.notify_all()
+        # Release the dropped jobs' PENDING registry entries: an
+        # admission waiting on "the farm will compile this" must see
+        # the farm is gone and claim the program itself.
+        for key, _ in dropped_jobs:
+            self.registry.release(key)
+        if dropped_jobs:
+            _emit("precompile_dropped", jobs=len(dropped_jobs))
+        if wait:
+            for t in self._threads:
+                t.join(timeout=timeout_s)
+
+    def drain(self, timeout_s: float = 120.0) -> bool:
+        """Block until every queued job has been compiled (tests/bench
+        warmers). False on timeout."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout_s
+        with self._lock:
+            while self._jobs or self._in_flight:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._wake.wait(timeout=min(remaining, 0.5))
+        return True

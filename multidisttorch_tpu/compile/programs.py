@@ -1,0 +1,294 @@
+"""Program specs: the one vocabulary for "which compiled step is this".
+
+Every layer of the compile subsystem — the background precompile farm
+(:mod:`~multidisttorch_tpu.compile.farm`), the driver's admission path
+(``hpo/driver.py``), and the cost books
+(``telemetry/device.py``) — must agree on three things about a train
+program before an executable compiled by one can be used by another:
+
+- its **key** (:func:`single_train_key` / :func:`stacked_train_key`
+  etc.): the PR 4 memoization vocabulary — shape bucket + the scalar
+  hypers that XLA bakes in as constants — EXTENDED with the submesh
+  device fingerprint, because an executable is loaded onto specific
+  devices and a bucket-twin compiled for group 0 cannot serve group 1
+  (exception: the device-agnostic init program, whose output the
+  driver places itself — :func:`single_init_key`);
+- its **argument avals** (:func:`single_avals` / :func:`stacked_avals`):
+  derived by ``jax.eval_shape`` over the SAME state constructors the
+  driver materializes real states with (``train.steps.build_train_state``
+  / ``build_stacked_train_state``), so a farm-compiled executable's
+  input signature cannot drift from the arrays the driver will feed it;
+- its **builder** (:func:`build_single_steps` / :func:`build_stacked_steps`):
+  the literal ``make_*_step`` factory calls the driver makes, so the
+  lowered HLO is the driver's program, not a reimplementation.
+
+Scalar hypers matter for SINGLE-path keys: ``lr`` lives inside
+``optax.adam``'s closures and ``beta`` multiplies the KL term — both
+are compile-time constants, so two bucket-twins with different lr
+compile to different executables. The stacked path passes hypers as
+``(K,)`` arrays (``TrialHypers``), so ONE program serves the whole
+bucket regardless of hypers — which is why its key carries the lane
+count instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from multidisttorch_tpu.models.vae import VAE
+from multidisttorch_tpu.parallel.mesh import TrialMesh
+from multidisttorch_tpu.train.steps import (
+    TrialHypers,
+    build_stacked_train_state,
+    build_train_state,
+    make_multi_step,
+    make_stacked_multi_step,
+    make_stacked_train_step,
+    make_train_step,
+)
+
+# Program kinds — the first element of every key, and the ``kind`` tag
+# on every compile_* event.
+SINGLE_TRAIN = "train"
+SINGLE_MULTI = "multi"
+SINGLE_INIT = "init"
+STACKED_TRAIN = "stacked_train"
+STACKED_MULTI = "stacked_multi"
+
+
+def mesh_fingerprint(trial: TrialMesh) -> tuple:
+    """The device identity an executable is pinned to: the ordered
+    global device ids of the trial's submesh. Two groups with identical
+    shapes still get distinct fingerprints — XLA loads an executable
+    onto concrete devices, so sharing across groups is never legal."""
+    return tuple(d.id for d in trial.devices)
+
+
+def single_train_key(trial: TrialMesh, cfg, bucket_key: tuple) -> tuple:
+    return (
+        SINGLE_TRAIN,
+        bucket_key,
+        (float(cfg.lr), float(cfg.beta)),
+        mesh_fingerprint(trial),
+    )
+
+
+def single_multi_key(trial: TrialMesh, cfg, bucket_key: tuple) -> tuple:
+    return (
+        SINGLE_MULTI,
+        bucket_key,
+        (float(cfg.lr), float(cfg.beta)),
+        mesh_fingerprint(trial),
+    )
+
+
+def single_init_key(trial: TrialMesh, cfg, bucket_key: tuple) -> tuple:
+    """The state-init program's key. Unlike the train programs, init
+    never reads the scalar hypers (``optax.adam(lr).init`` is
+    ``zeros_like``; lr only enters at update), so lr/beta twins SHARE
+    one init executable — the extra slot is None to keep the key shape
+    uniform. It is also the one DEVICE-AGNOSTIC program: the init fn
+    is jitted with no sharding/device pinning (the driver
+    ``device_put``s its output onto the trial's submesh afterward), so
+    the mesh slot is empty and every group shares one compile instead
+    of N groups each paying for a bit-identical lowering."""
+    return (SINGLE_INIT, bucket_key, None, ())
+
+
+def stacked_train_key(
+    trial: TrialMesh, bucket_key: tuple, lanes: int
+) -> tuple:
+    return (STACKED_TRAIN, bucket_key, int(lanes), mesh_fingerprint(trial))
+
+
+def stacked_multi_key(
+    trial: TrialMesh, bucket_key: tuple, lanes: int
+) -> tuple:
+    return (STACKED_MULTI, bucket_key, int(lanes), mesh_fingerprint(trial))
+
+
+def program_label(key: tuple) -> str:
+    """Human-readable program name for events/metrics/console — the
+    bucket signature, lane count or hypers, and the anchor device, in
+    one short string (e.g. ``stacked_train:bs128-h400-z20-f1-K4@d0``).
+    Labels feed telemetry events, so an unexpected key shape degrades
+    to ``repr`` instead of raising."""
+    try:
+        return _program_label(key)
+    except Exception:  # noqa: BLE001 — a label must never raise
+        return repr(key)
+
+
+def _program_label(key: tuple) -> str:
+    kind, bucket, extra, mesh = key
+    bs, hidden, latent, fused, grad_accum, remat = bucket
+    sig = f"bs{bs}-h{hidden}-z{latent}-f{fused}"
+    if grad_accum and grad_accum != 1:
+        sig += f"-ga{grad_accum}"
+    if remat:
+        sig += "-rm"
+    if kind in (STACKED_TRAIN, STACKED_MULTI):
+        sig += f"-K{extra}"
+    elif kind == SINGLE_INIT:
+        pass  # init bakes no hypers — lr/beta twins share it
+    else:
+        # Single-path programs bake lr/beta in as constants — two
+        # bucket-twins with different hypers are different executables
+        # and must not share a label (the snapshot/console key).
+        lr, beta = extra
+        sig += f"-lr{lr:g}"
+        if beta != 1.0:
+            sig += f"-b{beta:g}"
+    # The init program carries no device pinning (empty mesh slot) —
+    # its label says so instead of claiming an anchor device.
+    return f"{kind}:{sig}@d{mesh[0]}" if mesh else f"{kind}:{sig}@shared"
+
+
+def _rng_aval():
+    return jax.eval_shape(lambda: jax.random.key(0))
+
+
+def _leaf_sig(tree: Any) -> tuple:
+    return tuple(
+        (tuple(x.shape), str(x.dtype)) for x in jax.tree.leaves(tree)
+    )
+
+
+def avals_match(avals: Any, args: Any) -> bool:
+    """Whether ``args`` (real arrays or avals, a tuple of the call's
+    positional arguments) structurally match a compiled entry's
+    ``avals`` — same leaf count, shapes, and dtypes. The admission
+    guard: a mismatch means the builder vocabulary drifted from the
+    driver's real arrays, and the right move is the jit fallback, not
+    a call-time TypeError inside the sweep loop."""
+    try:
+        return _leaf_sig(avals) == _leaf_sig(args)
+    except Exception:  # noqa: BLE001 — guard must never raise
+        return False
+
+
+def default_model(cfg) -> VAE:
+    """The default trial model family (the only family the farm and
+    stacking cover — custom ``model_builder`` trials compile inline)."""
+    return VAE(hidden_dim=cfg.hidden_dim, latent_dim=cfg.latent_dim)
+
+
+def single_avals(cfg, model: Optional[VAE] = None) -> dict:
+    """Argument avals for the classic path's programs, derived from the
+    same constructors the driver materializes real args with:
+    ``{"train": (state, batch, rng), "multi": (state, chunk, rng)|None}``.
+    """
+    model = model or default_model(cfg)
+    tx = optax.adam(cfg.lr)
+    state = jax.eval_shape(
+        lambda: build_train_state(model, tx, jax.random.key(0))
+    )
+    rng = _rng_aval()
+    batch = jax.ShapeDtypeStruct(
+        (cfg.batch_size, model.input_dim), jnp.float32
+    )
+    out = {"train": (state, batch, rng), "multi": None}
+    if cfg.fused_steps > 1:
+        chunk = jax.ShapeDtypeStruct(
+            (cfg.fused_steps, cfg.batch_size, model.input_dim), jnp.float32
+        )
+        out["multi"] = (state, chunk, rng)
+    return out
+
+
+def stacked_avals(template, lanes: int, model: Optional[VAE] = None) -> dict:
+    """Argument avals for a stacked bucket's programs:
+    ``{"train": (state, hypers, batch, base_rngs, lane_steps),
+    "multi": (...)|None}`` — stacked state/hypers/rngs shaped by the
+    same ``build_stacked_train_state`` / ``TrialHypers.stack`` the
+    bucket runner uses."""
+    model = model or default_model(template)
+    lanes = int(lanes)
+    state = jax.eval_shape(
+        lambda: build_stacked_train_state(model, list(range(lanes)))
+    )
+    hypers = jax.eval_shape(
+        lambda: TrialHypers.stack([1e-3] * lanes, [1.0] * lanes)
+    )
+    base_rngs = jax.eval_shape(
+        lambda: jnp.stack([jax.random.key(i) for i in range(lanes)])
+    )
+    lane_steps = jax.ShapeDtypeStruct((lanes,), jnp.int32)
+    batch = jax.ShapeDtypeStruct(
+        (lanes, template.batch_size, model.input_dim), jnp.float32
+    )
+    out = {
+        "train": (state, hypers, batch, base_rngs, lane_steps),
+        "multi": None,
+    }
+    if template.fused_steps > 1:
+        chunk = jax.ShapeDtypeStruct(
+            (
+                template.fused_steps,
+                lanes,
+                template.batch_size,
+                model.input_dim,
+            ),
+            jnp.float32,
+        )
+        out["multi"] = (state, hypers, chunk, base_rngs, lane_steps)
+    return out
+
+
+def build_init_fn(cfg, model: Optional[VAE] = None):
+    """The state-init program: ``jit(rng -> un-placed TrainState)`` —
+    the same :func:`~multidisttorch_tpu.train.steps.build_train_state`
+    the driver materializes with, jitted so the farm can AOT-compile
+    it. Init is pure elementwise RNG sampling + ``zeros_like`` (no
+    matmul reassociation surface), so the compiled program's state is
+    bit-identical to the eager path's (regression-tested)."""
+    model = model or default_model(cfg)
+    tx = optax.adam(cfg.lr)
+    return jax.jit(lambda rng: build_train_state(model, tx, rng))
+
+
+def init_avals() -> tuple:
+    """Argument avals for the init program: one typed rng key."""
+    return (_rng_aval(),)
+
+
+def build_single_steps(
+    trial: TrialMesh, cfg, model: Optional[VAE] = None
+) -> dict:
+    """The classic path's jit step functions — the exact factory calls
+    ``_TrialRun.__init__`` makes for the default family."""
+    model = model or default_model(cfg)
+    tx = optax.adam(cfg.lr)
+    train = make_train_step(
+        trial, model, tx, beta=cfg.beta, remat=cfg.remat,
+        grad_accum=cfg.grad_accum,
+    )
+    multi = (
+        make_multi_step(
+            trial, model, tx, beta=cfg.beta, remat=cfg.remat,
+            grad_accum=cfg.grad_accum,
+        )
+        if cfg.fused_steps > 1
+        else None
+    )
+    return {"train": train, "multi": multi}
+
+
+def build_stacked_steps(
+    trial: TrialMesh, template, model: Optional[VAE] = None
+) -> dict:
+    """The stacked bucket's jit step functions — the exact factory
+    calls ``_StackedBucketRun.__init__`` makes."""
+    model = model or default_model(template)
+    kw = dict(remat=template.remat, grad_accum=template.grad_accum)
+    train = make_stacked_train_step(trial, model, **kw)
+    multi = (
+        make_stacked_multi_step(trial, model, **kw)
+        if template.fused_steps > 1
+        else None
+    )
+    return {"train": train, "multi": multi}

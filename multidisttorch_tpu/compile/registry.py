@@ -1,0 +1,376 @@
+"""Process-lifetime executable registry: one compile per program, ever.
+
+The driver's compile tax had two shapes before this module existed:
+every trial's first dispatch paid a full ``lower→compile`` inline on
+the host loop (even when a bucket-twin had compiled the identical
+program minutes earlier — jax's in-process caches do not connect a
+fresh ``jax.jit`` closure to an existing executable), and the cost
+books paid a SECOND lower+compile per program for ``cost_analysis``.
+The registry is the one table both problems fold into:
+
+- every compile of a driver train program goes through
+  :meth:`ExecutableRegistry.compile_now` — timed, emitted as
+  ``compile_start`` / ``compile_end`` events with per-program
+  compile-seconds metrics, and coalesced (a second thread asking for a
+  program mid-compile WAITS for the first instead of duplicating the
+  XLA work);
+- the resulting ``jax.stages.Compiled`` executable is held under the
+  program key (:mod:`~multidisttorch_tpu.compile.programs`) so the next
+  same-program admission — a bucket twin, a retry attempt, a refilled
+  lane — takes it instantly (``cache_hit`` event);
+- the cost books (``telemetry/device.py``) read
+  ``compiled.cost_analysis()`` straight off the stored executable —
+  the PR 4 re-lower+compile duplication is gone.
+
+Ownership protocol (farm vs driver): a farm job starts ``PENDING``;
+the worker moves it to ``COMPILING``; the driver's admission path
+either ``take()``s a ``READY`` executable, cooperatively waits out a
+``COMPILING`` one (yielding its submesh's host-loop slot, never
+blocking other trials), or ``claim()``s a still-``PENDING`` job and
+compiles inline itself (the farm worker sees ``CLAIMED`` and skips).
+``FAILED`` is terminal and sticky — admission falls back to the plain
+jit path and never retries a known-bad lowering.
+
+Thread-safety: one registry lock guards the table; each entry carries
+a condition for coalescing waits. Compiles themselves run OUTSIDE the
+lock (XLA releases the GIL — farm workers genuinely overlap).
+
+Size bound: the table is LRU-capped at ``MDT_REGISTRY_MAX_PROGRAMS``
+(default 512) terminal entries, so a long-lived sweep *service* — many
+``run_hpo`` calls over distinct baked-in hyperparameters — cannot grow
+device-loaded executables without bound. An evicted program simply
+recompiles on its next admission; within one sweep the cap is never
+reached.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from multidisttorch_tpu.compile.programs import program_label
+from multidisttorch_tpu.telemetry.events import get_bus
+from multidisttorch_tpu.telemetry.metrics import get_registry as _metrics
+
+PENDING = "pending"
+COMPILING = "compiling"
+READY = "ready"
+FAILED = "failed"
+CLAIMED = "claimed"
+
+# How the executable came to exist — the `source` tag on compile
+# events and the admission outcome vocabulary.
+SOURCE_PRECOMPILE = "precompile"
+SOURCE_INLINE = "inline"
+
+# Registry size bound: a long-lived sweep service calling run_hpo over
+# many hyperparameter values accumulates one device-loaded executable
+# per distinct single-path program (lr/beta are baked into those keys)
+# — without a cap that is unbounded resident host+device memory.
+# Terminal entries (READY/FAILED) beyond the bound are dropped
+# least-recently-used; the default is far above any one sweep, so
+# within-sweep sharing (twins, retries, refills) never evicts.
+MAX_PROGRAMS = int(os.environ.get("MDT_REGISTRY_MAX_PROGRAMS", "512"))
+
+
+class Entry:
+    """One program's lifecycle record. Public fields are read-mostly;
+    mutations happen under the owning registry's lock."""
+
+    __slots__ = (
+        "key",
+        "label",
+        "status",
+        "source",
+        "compiled",
+        "avals",
+        "compile_s",
+        "error",
+        "cond",
+        "hits",
+        "seq",
+    )
+
+    def __init__(self, key: tuple, lock: threading.RLock):
+        self.key = key
+        self.label = program_label(key)
+        self.status = PENDING
+        self.source: Optional[str] = None
+        self.compiled = None
+        self.avals = None
+        self.compile_s: Optional[float] = None
+        self.error: Optional[str] = None
+        self.cond = threading.Condition(lock)
+        self.hits = 0
+        self.seq = 0  # LRU stamp, bumped on every touch under the lock
+
+
+def _emit(kind: str, **data) -> None:
+    bus = get_bus()
+    if bus is not None:
+        bus.emit(kind, **data)
+
+
+class ExecutableRegistry:
+    """The process-wide program-key → compiled-executable table."""
+
+    def __init__(self, max_programs: Optional[int] = None):
+        self._lock = threading.RLock()
+        self._entries: dict[tuple, Entry] = {}
+        self._seq = 0
+        self.max_programs = (
+            MAX_PROGRAMS if max_programs is None else max_programs
+        )
+        self.evicted = 0
+
+    # -- bookkeeping --------------------------------------------------
+
+    def _touch(self, e: Entry) -> None:
+        # under self._lock
+        self._seq += 1
+        e.seq = self._seq
+
+    def _entry(self, key: tuple) -> Entry:
+        e = self._entries.get(key)
+        if e is None:
+            e = self._entries[key] = Entry(key, self._lock)
+            self._touch(e)
+            self._maybe_evict()
+        return e
+
+    def _maybe_evict(self) -> None:
+        # under self._lock. Only terminal entries are evictable:
+        # PENDING/CLAIMED/COMPILING carry live farm/driver ownership
+        # (and waiters on their condition), so they always survive.
+        if self.max_programs <= 0 or len(self._entries) <= self.max_programs:
+            return
+        victims = sorted(
+            (e for e in self._entries.values() if e.status in (READY, FAILED)),
+            key=lambda e: e.seq,
+        )
+        for e in victims:
+            if len(self._entries) <= self.max_programs:
+                break
+            del self._entries[e.key]
+            self.evicted += 1
+            reg = _metrics()
+            if reg is not None:
+                reg.counter("compile_registry_evictions").inc()
+
+    def status(self, key: tuple) -> Optional[str]:
+        with self._lock:
+            e = self._entries.get(key)
+            return e.status if e is not None else None
+
+    def entry(self, key: tuple) -> Optional[Entry]:
+        with self._lock:
+            return self._entries.get(key)
+
+    def schedule(self, key: tuple) -> bool:
+        """Register a farm job: create the entry in ``PENDING``. False
+        when the program already has an entry (ready, in flight, or
+        claimed) — the farm submits once per distinct program."""
+        with self._lock:
+            if key in self._entries:
+                return False
+            self._entry(key)
+            return True
+
+    def release(self, key: tuple) -> bool:
+        """Drop a still-PENDING entry (a farm shutdown returning its
+        queued jobs): the program goes back to unknown, so the next
+        admission claims and compiles it inline instead of waiting for
+        a worker that will never come."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e.status == PENDING:
+                del self._entries[key]
+                return True
+            return False
+
+    def claim(self, key: tuple) -> bool:
+        """The driver takes ownership of a queued-but-unstarted job (or
+        of a program the farm never saw): True means the caller should
+        compile inline; the farm worker will skip a ``CLAIMED`` entry."""
+        with self._lock:
+            e = self._entry(key)
+            if e.status == PENDING:
+                e.status = CLAIMED
+                return True
+            return e.status == CLAIMED
+
+    def fail(self, key: tuple, error: str) -> None:
+        """Mark a program terminally FAILED (a farm builder that cannot
+        even construct the jit fn/avals): waiters stop waiting, and
+        every admission takes the jit fallback from here on."""
+        with self._lock:
+            e = self._entry(key)
+            if e.status == READY:
+                return
+            e.status = FAILED
+            e.error = error
+            e.cond.notify_all()
+
+    def begin(self, key: tuple, *, source: str) -> Optional[Entry]:
+        """Move an entry to ``COMPILING`` (from PENDING/CLAIMED/new).
+        None when someone else already owns it (compiling) or it is
+        terminal (ready/failed) — the caller should coalesce or take."""
+        with self._lock:
+            e = self._entry(key)
+            if e.status in (READY, FAILED, COMPILING):
+                return None
+            e.status = COMPILING
+            e.source = source
+            return e
+
+    def take(self, key: tuple) -> Optional[Any]:
+        """A READY program's executable, else None — the non-blocking
+        admission read. Counts hits and emits ``cache_hit``."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.status != READY:
+                return None
+            e.hits += 1
+            self._touch(e)
+            label, source = e.label, e.source
+        _emit("cache_hit", program=label, source=source)
+        reg = _metrics()
+        if reg is not None:
+            reg.counter("compile_cache_hits", program=label).inc()
+        return e.compiled
+
+    def avals(self, key: tuple):
+        with self._lock:
+            e = self._entries.get(key)
+            return e.avals if e is not None else None
+
+    # -- the one compile routine --------------------------------------
+
+    def compile_now(
+        self,
+        key: tuple,
+        fn: Callable,
+        avals: tuple,
+        *,
+        source: str = SOURCE_INLINE,
+        wait_s: float = 600.0,
+    ) -> Entry:
+        """AOT-compile ``fn.lower(*avals).compile()`` under ``key``.
+
+        Exactly one thread compiles a given key; a concurrent caller
+        coalesces (waits on the entry condition, bounded by ``wait_s``)
+        and returns the same entry — duplicate-signature farm jobs and
+        a driver racing a farm worker cost ONE compile between them.
+        Failures are recorded terminally (status FAILED, error text);
+        the entry is returned either way — callers check ``status``.
+        """
+        with self._lock:
+            e = self._entry(key)
+            if e.status == READY or e.status == FAILED:
+                return e
+            if e.status == COMPILING:
+                _emit("precompile_coalesced", program=e.label)
+                reg = _metrics()
+                if reg is not None:
+                    reg.counter("compile_coalesced").inc()
+                deadline = time.monotonic() + wait_s
+                while e.status == COMPILING:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    e.cond.wait(timeout=min(remaining, 1.0))
+                return e
+            e.status = COMPILING
+            e.source = source
+        _emit(
+            "compile_start", program=e.label, program_kind=key[0],
+            source=source,
+        )
+        t0 = time.perf_counter()
+        compiled = None
+        error = None
+        try:
+            try:
+                compiled = fn.lower(*avals).compile()
+            except Exception as ex:  # noqa: BLE001 — a failed AOT
+                # compile must degrade to the jit fallback, never kill
+                # the sweep
+                error = f"{type(ex).__name__}: {ex}"
+        finally:
+            # Terminal-status-always (even on BaseException, e.g. a
+            # KeyboardInterrupt unwinding a farm worker): an entry
+            # stuck COMPILING would spin every coalescing waiter to
+            # its deadline.
+            dt = time.perf_counter() - t0
+            with self._lock:
+                e.compile_s = dt
+                if compiled is not None:
+                    e.compiled = compiled
+                    e.avals = avals
+                    e.status = READY
+                else:
+                    e.error = error or "compile interrupted"
+                    e.status = FAILED
+                self._touch(e)
+                e.cond.notify_all()
+        _emit(
+            "compile_end",
+            program=e.label,
+            program_kind=key[0],
+            source=source,
+            compile_s=round(dt, 4),
+            ok=compiled is not None,
+            **({"error": error[:300]} if error else {}),
+        )
+        reg = _metrics()
+        if reg is not None:
+            reg.counter("compiles", source=source).inc()
+            reg.counter("compile_seconds", program=e.label).inc(dt)
+            reg.counter("compile_seconds_total").inc(dt)
+            if error:
+                reg.counter("compile_failures").inc()
+        return e
+
+    # -- cost-book handoff (telemetry/device.py) ----------------------
+
+    def executable_for_cost(self, key: tuple) -> Optional[Any]:
+        """A READY executable for the cost books — no hit accounting,
+        no events: this is the dedup read, not an admission."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.status != READY:
+                return None
+            self._touch(e)
+            return e.compiled
+
+    def snapshot(self) -> dict:
+        """Per-program compile book: status, source, seconds, hits —
+        the run summary / console's view of the registry."""
+        with self._lock:
+            return {
+                e.label: {
+                    "status": e.status,
+                    "source": e.source,
+                    "compile_s": e.compile_s,
+                    "hits": e.hits,
+                    "error": e.error,
+                }
+                for e in self._entries.values()
+            }
+
+    def reset(self) -> None:
+        """Drop every entry (tests; also frees executables/devices)."""
+        with self._lock:
+            self._entries = {}
+
+
+_registry = ExecutableRegistry()
+
+
+def get_executable_registry() -> ExecutableRegistry:
+    """The process singleton. Always exists — the registry is a perf
+    layer, not telemetry; it only *emits* when a bus/metrics registry
+    is live, and costs one dict lookup when idle."""
+    return _registry

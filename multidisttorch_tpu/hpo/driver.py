@@ -352,10 +352,33 @@ class _TrialRun:
             if param_shardings_builder is not None
             else None
         )
-        self.state = create_train_state(
-            trial, model, tx, jax.random.key(cfg.seed),
-            param_shardings=param_sh,
+        # AOT eligibility (docs/COMPILE.md): the program vocabulary
+        # describes exactly the default model family with replicated
+        # weights on a single controller — the same envelope as trial
+        # stacking. Everything else keeps the plain jit paths.
+        # MDT_AOT_ADMISSION=0 is the kill switch.
+        aot_eligible = (
+            model_builder is None
+            and param_shardings_builder is None
+            and jax.process_count() == 1
+            and os.environ.get("MDT_AOT_ADMISSION", "1") != "0"
         )
+        self.state = None
+        if aot_eligible:
+            # The state-init program is itself part of the compile tax
+            # (flax init traces+compiles per trial): take the farm's
+            # executable if ready, else compile inline through the
+            # registry — timed, attributed, and shared by every
+            # same-bucket trial (lr twins included; init bakes no
+            # hypers). Bit-identical to the eager path by construction
+            # (elementwise RNG + zeros_like; regression-tested), and
+            # any failure falls back to it.
+            self.state = self._registry_init_state()
+        if self.state is None:
+            self.state = create_train_state(
+                trial, model, tx, jax.random.key(cfg.seed),
+                param_shardings=param_sh,
+            )
         self._state_sh = (
             state_shardings(self.state) if param_sh is not None else None
         )
@@ -381,28 +404,31 @@ class _TrialRun:
             if cfg.fused_steps > 1
             else None
         )
-        if injector is not None:
-            # Thread the chaos hooks through the step dispatch: a
-            # single-step dispatch covers 1 optimizer step, a fused
-            # chunk covers its leading dim. The wrappers are pure host
-            # code — no recompilation, no shape change.
-            tid = cfg.trial_id
-            self.train_step = wrap_step_with_hooks(
-                self.train_step,
-                before=lambda b: injector.step_hook(tid, self._step_no, 1),
-                transform_batch=lambda b: injector.poison_batch(
-                    tid, self._step_no, b, 1
-                ),
+        # Raw jit programs kept unwrapped for the AOT admission path
+        # (compile/registry.py): a registry executable replaces the RAW
+        # program, and the chaos hook-wrapping is re-applied around
+        # whichever wins — hooks are pure host code either way.
+        self._train_raw = self.train_step
+        self._multi_raw = self.multi_step
+        self.train_step = self._wrap_train(self.train_step)
+        if self.multi_step is not None:
+            self.multi_step = self._wrap_multi(self.multi_step)
+        # AOT admission: "take the finished executable if ready, else
+        # compile inline" (docs/COMPILE.md) — for the train programs,
+        # resolved cooperatively in run() before the first dispatch.
+        self._aot_keys: dict = {}
+        self._admission = {"outcome": "jit", "wait_s": 0.0, "program": None}
+        self._first_dispatched = False
+        if aot_eligible:
+            from multidisttorch_tpu.compile import programs as _cprog
+
+            bucket = stack_bucket_key(cfg)
+            self._aot_keys["train"] = _cprog.single_train_key(
+                trial, cfg, bucket
             )
-            if self.multi_step is not None:
-                self.multi_step = wrap_step_with_hooks(
-                    self.multi_step,
-                    before=lambda b: injector.step_hook(
-                        tid, self._step_no, b.shape[0]
-                    ),
-                    transform_batch=lambda b: injector.poison_batch(
-                        tid, self._step_no, b, b.shape[0]
-                    ),
+            if cfg.fused_steps > 1:
+                self._aot_keys["multi"] = _cprog.single_multi_key(
+                    trial, cfg, bucket
                 )
         # Reconstructions are materialized (and all-gathered back to
         # replicated) only when images are wanted. Keyed on the uniform
@@ -655,6 +681,111 @@ class _TrialRun:
         if self._verbose:
             log0(*args, trial=self.trial, level=level)
 
+    def _registry_init_state(self):
+        """Materialize this trial's TrainState through the compile
+        registry's init executable (docs/COMPILE.md): take the farm's
+        finished program if READY, else compile it inline through the
+        registry (coalescing with a mid-compile farm worker — never
+        longer than the eager init compile this replaces, and the
+        executable then serves every same-bucket trial). Returns the
+        PLACED state, or None for the eager ``create_train_state``
+        fallback (failed compile, torn registry, any exception)."""
+        from multidisttorch_tpu.compile import programs as _cprog
+        from multidisttorch_tpu.compile.registry import (
+            READY,
+            SOURCE_INLINE,
+            get_executable_registry,
+        )
+
+        cfg, trial = self.cfg, self.trial
+        try:
+            key = _cprog.single_init_key(trial, cfg, stack_bucket_key(cfg))
+            reg = get_executable_registry()
+            ex = reg.take(key)
+            if ex is None:
+                entry = reg.compile_now(
+                    key,
+                    _cprog.build_init_fn(cfg, self.model),
+                    _cprog.init_avals(),
+                    source=SOURCE_INLINE,
+                )
+                if entry.status == READY:
+                    ex = entry.compiled
+            if ex is None:
+                return None
+            return trial.device_put(ex(jax.random.key(cfg.seed)))
+        except Exception:  # noqa: BLE001 — init must never be the
+            # reason a trial cannot start; the eager path always works.
+            return None
+
+    def _wrap_train(self, fn):
+        """Chaos hook-wrapping for a single-step program (jit fn or AOT
+        executable — both are plain callables to the hooks)."""
+        if self._injector is None:
+            return fn
+        injector, tid = self._injector, self.cfg.trial_id
+        return wrap_step_with_hooks(
+            fn,
+            before=lambda b: injector.step_hook(tid, self._step_no, 1),
+            transform_batch=lambda b: injector.poison_batch(
+                tid, self._step_no, b, 1
+            ),
+        )
+
+    def _wrap_multi(self, fn):
+        if self._injector is None:
+            return fn
+        injector, tid = self._injector, self.cfg.trial_id
+        return wrap_step_with_hooks(
+            fn,
+            before=lambda b: injector.step_hook(
+                tid, self._step_no, b.shape[0]
+            ),
+            transform_batch=lambda b: injector.poison_batch(
+                tid, self._step_no, b, b.shape[0]
+            ),
+        )
+
+    def _admit_programs(self) -> Iterator[None]:
+        """Cooperative AOT admission (docs/COMPILE.md): swap registry
+        executables in for the raw jit programs before the first
+        dispatch. Yields while a farm worker is mid-compile — the host
+        loop keeps every OTHER submesh stepping, so admission never
+        blocks on XLA."""
+        if not self._aot_keys:
+            return
+        from multidisttorch_tpu.compile import programs as _cprog
+
+        primary = "multi" if self.cfg.fused_steps > 1 else "train"
+        raw = {"train": self._train_raw, "multi": self._multi_raw}
+        taken, self._admission = yield from _aot_admit(
+            self._aot_keys,
+            raw,
+            lambda: _cprog.single_avals(self.cfg),
+            self.state,
+            primary,
+        )
+        if "train" in taken:
+            self.train_step = self._wrap_train(taken["train"])
+        if "multi" in taken:
+            self.multi_step = self._wrap_multi(taken["multi"])
+
+    def _note_first_dispatch(self) -> None:
+        """One event per trial, right after the first step dispatch
+        returns: its timestamp minus the attempt_start's is the trial's
+        admission latency (setup + compile — the cold-start books'
+        headline number), and the data says how the program arrived
+        (hit/wait/inline/jit)."""
+        self._first_dispatched = True
+        bus = get_bus()
+        if bus is not None:
+            bus.emit(
+                "first_dispatch",
+                trial_id=self.cfg.trial_id,
+                group_id=self.trial.group_id,
+                **self._admission,
+            )
+
     def _device_seam(self, dt, fn, args, *, steps: int = 1) -> None:
         """Per-dispatch device-book seam (reached only with telemetry
         ON — call sites sit inside the ``self._mreg is not None``
@@ -798,6 +929,10 @@ class _TrialRun:
             self.result.checkpoint = self._ckpt_path
             self._log(f"Trial {cfg.trial_id} already complete; resumed.")
             return
+        # AOT admission before the first dispatch: take/wait-for/claim
+        # this trial's compiled programs (cooperative — yields keep the
+        # other submeshes stepping while a farm worker compiles ours).
+        yield from self._admit_programs()
         n_per_epoch = self.train_iter.samples_per_epoch
         # state.step counts optimizer updates, so it doubles as the
         # resume-safe global step for RNG folding. Kept as an attribute:
@@ -845,6 +980,8 @@ class _TrialRun:
                         self.state, batch, rng
                     )
                     self._step_no += 1
+                    if not self._first_dispatched:
+                        self._note_first_dispatch()
                     s = metrics["loss_sum"]  # on device, async
                     epoch_sum_dev = s if epoch_sum_dev is None else epoch_sum_dev + s
                     if self._mreg is not None:
@@ -870,6 +1007,8 @@ class _TrialRun:
                             self.state, chunk, rng
                         )
                         self._step_no += c
+                        if not self._first_dispatched:
+                            self._note_first_dispatch()
                         losses = metrics["loss_sum"]  # (K,) on device
                         s = losses.sum()  # device add, async
                         epoch_sum_dev = (
@@ -897,6 +1036,8 @@ class _TrialRun:
                                 self.state, chunk[j], rng
                             )
                             self._step_no += 1
+                            if not self._first_dispatched:
+                                self._note_first_dispatch()
                             s = metrics["loss_sum"]
                             epoch_sum_dev = (
                                 s
@@ -1190,6 +1331,111 @@ def _restore_drain_handlers() -> None:
             pass
 
 
+def _aot_admit(keys: dict, raw_fns: dict, avals_builder, state, primary):
+    """The one admission protocol (generator), shared by the classic
+    and stacked runners: for each program key, **take** a READY
+    registry executable, **wait cooperatively** (yield — the host loop
+    keeps other submeshes stepping) while a farm worker compiles the
+    PRIMARY program, or **claim** an unstarted primary and compile it
+    inline through the registry (same wall the jit path would pay at
+    first dispatch, but timed, attributed, and reusable by every
+    later same-program trial). Non-primary programs (the tail step of
+    a fused config) are take-if-ready only — never worth waiting or
+    inline-compiling for (jit compiles them lazily IF a tail exists).
+
+    Returns ``(executables, admission)`` where ``admission`` records
+    the primary's outcome: ``hit`` (ready at admission), ``wait``
+    (farm finished it while we yielded), ``inline`` (we compiled it),
+    ``jit`` (fallback — failed compile, aval mismatch, or wait
+    deadline). A registry executable is swapped in only when its
+    recorded avals structurally match the trial's REAL state (resume
+    restores, vocabulary drift) — mismatch is a silent jit fallback,
+    never a call-time TypeError mid-sweep.
+    """
+    from multidisttorch_tpu.compile import programs as _cprog
+    from multidisttorch_tpu.compile.registry import (
+        COMPILING,
+        PENDING,
+        READY,
+        SOURCE_INLINE,
+        get_executable_registry,
+    )
+
+    out: dict = {}
+    admission = {"outcome": "jit", "wait_s": 0.0, "program": None}
+    if not keys:
+        return out, admission
+    reg = get_executable_registry()
+    t0 = time.perf_counter()
+    wait_deadline = t0 + float(os.environ.get("MDT_AOT_WAIT_S", "600"))
+    avals = None
+    order = [primary] + [k for k in keys if k != primary]
+    for which in order:
+        key = keys[which]
+        is_primary = which == primary
+        waited = False
+        if is_primary:
+            # PENDING means a farm worker WILL compile this — wait for
+            # it too, not just COMPILING: claiming a queued farm job
+            # and compiling it inline would stall the host loop, which
+            # is the one thing the farm exists to prevent. (A torn
+            # farm shutdown releases its queued entries, so this wait
+            # cannot outlive the farm; the deadline bounds the rest.)
+            while (
+                reg.status(key) in (PENDING, COMPILING)
+                and time.perf_counter() < wait_deadline
+            ):
+                waited = True
+                time.sleep(0.001)
+                yield
+        # The avals guard runs BEFORE take(): take() books a cache_hit
+        # (event + hits counter), and a registry executable the guard
+        # is about to reject (resume restores, vocabulary drift) was
+        # never served — the books must show the jit fallback that
+        # actually ran, not a phantom hit. (Avals are immutable once
+        # READY, so check-then-take cannot race.)
+        ex = None
+        entry_avals = reg.avals(key)
+        rejected = entry_avals is not None and not _cprog.avals_match(
+            entry_avals[0], state
+        )
+        if not rejected:
+            ex = reg.take(key)
+        outcome = ("wait" if waited else "hit") if ex is not None else None
+        if ex is None and not rejected and is_primary and reg.claim(key):
+            if avals is None:
+                try:
+                    avals = avals_builder()
+                except Exception as e:  # noqa: BLE001 — aval
+                    # derivation failing is a registry problem, not a
+                    # trial problem: the jit fallback must still run.
+                    reg.fail(key, f"avals: {type(e).__name__}: {e}")
+                    avals = None
+            if avals is not None:
+                e = reg.compile_now(
+                    key, raw_fns[which], avals[which], source=SOURCE_INLINE
+                )
+                if e.status == READY:
+                    ex = e.compiled
+                    outcome = "inline"
+        if ex is not None:
+            entry_avals = reg.avals(key)
+            if entry_avals is None or not _cprog.avals_match(
+                entry_avals[0], state
+            ):
+                ex = None
+                outcome = None
+        if ex is not None:
+            out[which] = ex
+        if is_primary:
+            admission = {
+                "outcome": outcome or "jit",
+                "wait_s": round(time.perf_counter() - t0, 4),
+                "program": _cprog.program_label(key),
+            }
+    return out, admission
+
+
 def stack_bucket_key(cfg: TrialConfig) -> tuple:
     """The shape signature under which trials may share one compiled
     stacked program: everything that changes an array shape or the
@@ -1337,6 +1583,27 @@ class _StackedBucketRun:
             trial, self.model, [lane["cfg"].seed for lane in self.lanes]
         )
         self._refresh_lane_arrays()
+        # AOT admission for the bucket's vmapped programs (the stacked
+        # path is always the default family, single-controller — the
+        # same eligibility envelope as the classic path's check).
+        self._sstep_raw = self.sstep
+        self._smulti_raw = self.smulti
+        self._aot_keys: dict = {}
+        self._admission = {"outcome": "jit", "wait_s": 0.0, "program": None}
+        self._first_dispatched = False
+        if os.environ.get("MDT_AOT_ADMISSION", "1") != "0":
+            from multidisttorch_tpu.compile import programs as _cprog
+
+            bucket = stack_bucket_key(template)
+            lanes = len(self.lanes)
+            self._aot_keys["train"] = _cprog.stacked_train_key(
+                trial, bucket, lanes
+            )
+            if self.fused > 1:
+                self._aot_keys["multi"] = _cprog.stacked_multi_key(
+                    trial, bucket, lanes
+                )
+            self._aot_template = template
 
     def _fresh_lane(self, idx: int, cfg: TrialConfig) -> dict:
         return {
@@ -1746,7 +2013,43 @@ class _StackedBucketRun:
         ]
         return live + list(self.queue)
 
+    def _admit_programs(self) -> Iterator[None]:
+        """Cooperative AOT admission for the bucket (see
+        ``_TrialRun._admit_programs`` — same protocol, vmapped keys)."""
+        if not self._aot_keys:
+            return
+        from multidisttorch_tpu.compile import programs as _cprog
+
+        primary = "multi" if self.fused > 1 else "train"
+        raw = {"train": self._sstep_raw, "multi": self._smulti_raw}
+        lanes = len(self.lanes)
+        taken, self._admission = yield from _aot_admit(
+            self._aot_keys,
+            raw,
+            lambda: _cprog.stacked_avals(self._aot_template, lanes),
+            self.state,
+            primary,
+        )
+        if "train" in taken:
+            self.sstep = taken["train"]
+        if "multi" in taken:
+            self.smulti = taken["multi"]
+
+    def _note_first_dispatch(self) -> None:
+        """Bucket sibling of ``_TrialRun._note_first_dispatch`` —
+        group-scoped (no single trial owns the bucket's admission)."""
+        self._first_dispatched = True
+        bus = get_bus()
+        if bus is not None:
+            bus.emit(
+                "first_dispatch",
+                group_id=self.trial.group_id,
+                lanes=len(self.lanes),
+                **self._admission,
+            )
+
     def run(self) -> Iterator[None]:
+        yield from self._admit_programs()
         n_per_epoch = self.data.samples_per_epoch
         while any(lane is not None for lane in self.lanes):
             # Lane-scoped infra faults due this round fire BEFORE the
@@ -1789,6 +2092,8 @@ class _StackedBucketRun:
                         self.base_rngs, self._lane_steps(),
                     )
                     self._bump_steps(1)
+                    if not self._first_dispatched:
+                        self._note_first_dispatch()
                     add(m["loss_sum"])
                     if self._mreg is not None:
                         dt = self._mreg.step_mark(
@@ -1809,6 +2114,8 @@ class _StackedBucketRun:
                             self.base_rngs, self._lane_steps(),
                         )
                         self._bump_steps(s)
+                        if not self._first_dispatched:
+                            self._note_first_dispatch()
                         add(m["loss_sum"].sum(axis=0))
                         if self._mreg is not None:
                             dt = self._mreg.step_mark(
@@ -1830,6 +2137,8 @@ class _StackedBucketRun:
                                 self.base_rngs, self._lane_steps(),
                             )
                             self._bump_steps(1)
+                            if not self._first_dispatched:
+                                self._note_first_dispatch()
                             add(m["loss_sum"])
                             if self._mreg is not None:
                                 dt = self._mreg.step_mark(
@@ -1949,6 +2258,7 @@ def run_hpo(
     ledger: bool = True,
     ckpt_keep_last: int = 1,
     agree_timeout_s: Optional[float] = None,
+    precompile: Optional[bool] = None,
 ) -> list[TrialResult]:
     """Run the configs over disjoint submeshes, concurrently, with no
     cross-trial synchronization.
@@ -2060,6 +2370,22 @@ def run_hpo(
     second signal kills immediately. ``tools/sweep_supervisor.py``
     turns these contracts into automatic world-shrink restarts.
 
+    **Compile farm** (docs/COMPILE.md): ``precompile=True`` (default:
+    the ``MDT_PRECOMPILE=1`` env) walks the sweep's pending configs at
+    entry and AOT-compiles every distinct train program — shape bucket
+    x baked scalar hypers x predicted submesh — on background worker
+    threads, so trial admission takes a finished executable instead of
+    paying ``lower→compile`` on the host loop. Admission to a program
+    still mid-compile waits *cooperatively* (other submeshes keep
+    stepping); a program the farm has not reached is claimed and
+    compiled inline (the pre-farm behavior, now timed and attributed
+    per bucket as ``compile_start``/``compile_end``/``cache_hit``
+    telemetry). Single-controller, default model family — the same
+    envelope as stacking; other sweeps silently skip the farm. Every
+    compile lands in the process-lifetime executable registry, so
+    bucket-twin trials, retries, and refilled lanes never recompile
+    even with the farm off.
+
     Returns results for locally-run trials, in config order.
     """
     if profile_dir is not None:
@@ -2071,6 +2397,11 @@ def run_hpo(
 
         trace_ctx = contextlib.nullcontext()
     _install_drain_handlers()
+    # The precompile farm (if the body starts one) is stashed here so
+    # EVERY exit path — completion, failure isolation re-raise,
+    # preemption, drain — tears it down: queued jobs are dropped and
+    # in-flight compiles finish harmlessly into the registry.
+    pool_holder: list = []
     try:
         with trace_ctx:
             return _run_hpo_body(
@@ -2096,8 +2427,12 @@ def run_hpo(
                 ledger=ledger,
                 ckpt_keep_last=ckpt_keep_last,
                 agree_timeout_s=agree_timeout_s,
+                precompile=precompile,
+                _pool_holder=pool_holder,
             )
     finally:
+        for _pool in pool_holder:
+            _pool.shutdown()
         _restore_drain_handlers()
 
 
@@ -2159,6 +2494,8 @@ def _run_hpo_body(
     ledger=True,
     ckpt_keep_last=1,
     agree_timeout_s=None,
+    precompile=None,
+    _pool_holder=None,
 ) -> list[TrialResult]:
     # Telemetry opt-in by environment (MDT_TELEMETRY[_DIR]) — a no-op
     # env read when off, and an explicit telemetry.configure() wins.
@@ -2435,6 +2772,33 @@ def _run_hpo_body(
     # = a retry still in its backoff window (skipped, not blocking —
     # other queued work runs first).
     shared = [(k, m, 0.0) for k, m in build_items()]
+    # Background AOT precompile farm (docs/COMPILE.md): the work plan
+    # above names every distinct program this sweep will compile, so
+    # compile them NOW on worker threads — overlapped with the first
+    # trials' setup and training — instead of inline at each admission.
+    # Same eligibility envelope as the AOT admission path; the group
+    # prediction (item j -> group j % n) only gates WHICH submesh an
+    # executable is pinned to — a misprediction is a registry miss and
+    # an inline compile, never a wrong program.
+    if precompile is None:
+        precompile = os.environ.get("MDT_PRECOMPILE") == "1"
+    if (
+        precompile
+        and single
+        and model_builder is None
+        and param_shardings_builder is None
+        and os.environ.get("MDT_AOT_ADMISSION", "1") != "0"
+    ):
+        from multidisttorch_tpu.compile.farm import PrecompilePool
+
+        _farm = PrecompilePool()
+        _farm.plan_sweep(
+            [(k, m) for k, m, _ in shared],
+            groups,
+            max_lanes=stack_max_lanes,
+        )
+        if _pool_holder is not None:
+            _pool_holder.append(_farm)
     per_group: dict[int, list] = {g.group_id: [] for g in groups}
     if not single:
         assignment = balanced_assignment(

@@ -92,20 +92,55 @@ def peak_membw_per_chip(device_kind: str) -> Optional[float]:
     return _lookup_by_kind(PEAK_HBM_BYTES_PER_S, device_kind)
 
 
+def _aot_executable(fn):
+    """An already-compiled executable reachable from ``fn``: ``fn``
+    itself or its ``__wrapped__`` (hook wrappers tag it) when that
+    object carries ``cost_analysis`` but no ``lower`` — the
+    ``jax.stages.Compiled`` shape the compile registry hands the
+    driver. jit functions have ``lower`` and no ``cost_analysis``, so
+    the discrimination is exact."""
+    for cand in (fn, getattr(fn, "__wrapped__", None)):
+        if cand is None:
+            continue
+        if hasattr(cand, "cost_analysis") and not hasattr(cand, "lower"):
+            return cand
+    return None
+
+
 def compiled_cost_analysis(fn, args: tuple, kwargs: dict = None) -> dict:
     """XLA's post-optimization cost analysis of ``fn(*args)``.
 
     Returns ``{"flops": float|None, "bytes_accessed": float|None,
     "reason": str|None}`` — reason set exactly when flops is None.
     ``fn`` may be a jit function or a host wrapper exposing the
-    underlying jit via ``__wrapped__`` (``wrap_step_with_hooks`` tags
-    it). The lower+compile here is an AOT pass separate from the jit
-    call cache — a one-time compile-cost, paid only with telemetry on.
+    underlying program via ``__wrapped__`` (``wrap_step_with_hooks``
+    tags it). When the program is ALREADY an AOT executable (the
+    compile registry's ``Compiled`` — docs/COMPILE.md), the analysis
+    is read straight off it: zero re-lowering, zero re-compiling —
+    the cost books and the compile farm share one executable. Only a
+    plain jit fn pays the AOT lower+compile here (a one-time
+    compile-cost, paid only with telemetry on, and itself served from
+    jax's in-process caches when the registry compiled the same
+    program already).
 
     Shapes are all that matter to the analysis, so calling this after
     the first real dispatch (with the *new*, post-donation state) is
     equivalent to analyzing the program that actually ran.
     """
+    aot = _aot_executable(fn)
+    if aot is not None:
+        try:
+            cost = aot.cost_analysis()
+        except Exception as e:  # noqa: BLE001 — observability never
+            # raises
+            return {
+                "flops": None,
+                "bytes_accessed": None,
+                "reason": (
+                    f"cost_analysis failed: {type(e).__name__}: {e}"
+                ),
+            }
+        return _fold_cost(cost)
     # Prefer the function's own .lower; only fall through __wrapped__
     # when the outer object has none (a host hook wrapper). jit
     # functions themselves carry a __wrapped__ (the raw Python body,
@@ -127,6 +162,10 @@ def compiled_cost_analysis(fn, args: tuple, kwargs: dict = None) -> dict:
             "bytes_accessed": None,
             "reason": f"cost_analysis failed: {type(e).__name__}: {e}",
         }
+    return _fold_cost(cost)
+
+
+def _fold_cost(cost) -> dict:
     # Older jaxlibs return a per-device-program list, newer a dict.
     if isinstance(cost, (list, tuple)):
         cost = cost[0] if cost else None

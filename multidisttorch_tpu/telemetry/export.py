@@ -305,12 +305,26 @@ class SweepFold:
         # multi-controller stream) are counted once.
         self._covered: dict[int, int] = {}
         self._ended: set[tuple[int, int, str]] = set()
+        # attempt_start timestamps by trial: first_dispatch - this =
+        # the trial's admission latency (setup + compile).
+        self._attempt_ts: dict[int, float] = {}
         self.done = False
         # Device books folded off device_cost / device_memory events,
         # keyed by step-series key ("trial-3" / "bucket-g0") — the live
         # console's copy of what the registry holds in-process.
         self.device: dict[str, dict] = {}
         self.anomalies = 0
+        # Compile books (docs/COMPILE.md) folded off the compile
+        # subsystem's events: per-program compile-seconds/source off
+        # compile_end, registry hits off cache_hit, farm lifecycle off
+        # precompile_*, per-trial admission latency off first_dispatch
+        # joined with its attempt_start.
+        self.compile_books: dict[str, dict] = {}
+        self.cache_hits = 0
+        self.compiles = 0
+        self.compile_s_total = 0.0
+        self.precompile: dict[str, int] = {}
+        self.admissions: list[dict] = []
         # Fleet tags (host slot -> event count) — empty on an untagged
         # single-host stream; the fleet console folds a merged stream
         # through the same class.
@@ -381,6 +395,65 @@ class SweepFold:
                     book["memory_source"] = data.get("source")
         if kind.startswith("anomaly_"):
             self.anomalies += 1
+        if kind == "compile_end":
+            data = ev.get("data") or {}
+            prog = str(data.get("program", "?"))
+            b = self.compile_books.setdefault(
+                prog,
+                {
+                    "kind": data.get("program_kind"),
+                    "source": data.get("source"),
+                    "compiles": 0,
+                    "compile_s": 0.0,
+                    "hits": 0,
+                    "ok": True,
+                },
+            )
+            b["compiles"] += 1
+            b["compile_s"] = round(
+                b["compile_s"] + float(data.get("compile_s") or 0.0), 4
+            )
+            b["source"] = data.get("source", b["source"])
+            if data.get("ok") is False:
+                b["ok"] = False
+                b["error"] = data.get("error")
+            self.compiles += 1
+            self.compile_s_total = round(
+                self.compile_s_total + float(data.get("compile_s") or 0.0),
+                4,
+            )
+        elif kind == "cache_hit":
+            data = ev.get("data") or {}
+            prog = str(data.get("program", "?"))
+            if prog in self.compile_books:
+                self.compile_books[prog]["hits"] += 1
+            else:
+                self.compile_books[prog] = {
+                    "kind": None,
+                    "source": data.get("source"),
+                    "compiles": 0,
+                    "compile_s": 0.0,
+                    "hits": 1,
+                    "ok": True,
+                }
+            self.cache_hits += 1
+        elif kind.startswith("precompile_"):
+            short = kind[len("precompile_"):]
+            self.precompile[short] = self.precompile.get(short, 0) + 1
+        elif kind == "first_dispatch" and ev.get("trial_id") is None:
+            # The stacked bucket's admission (group-scoped; per-trial
+            # first_dispatch falls through to the trial fold below).
+            data = ev.get("data") or {}
+            self.admissions.append(
+                {
+                    "trial_id": None,
+                    "group": ev.get("group_id"),
+                    "outcome": data.get("outcome"),
+                    "wait_s": data.get("wait_s"),
+                    "admission_s": None,
+                    "program": data.get("program"),
+                }
+            )
         if ev.get("host") is not None:
             h = int(ev["host"])
             self.hosts[h] = self.hosts.get(h, 0) + 1
@@ -405,6 +478,24 @@ class SweepFold:
         if kind == "attempt_start":
             t["attempts"] = max(t["attempts"], int(ev.get("attempt") or 0))
             t["status"] = "in_flight"
+            self._attempt_ts[int(tid)] = ts
+        elif kind == "first_dispatch":
+            start = self._attempt_ts.get(int(tid))
+            t["admission_s"] = (
+                round(ts - start, 4) if start is not None else None
+            )
+            t["compile_outcome"] = data.get("outcome")
+            t["compile_program"] = data.get("program")
+            self.admissions.append(
+                {
+                    "trial_id": int(tid),
+                    "group": ev.get("group_id"),
+                    "outcome": data.get("outcome"),
+                    "wait_s": data.get("wait_s"),
+                    "admission_s": t["admission_s"],
+                    "program": data.get("program"),
+                }
+            )
         elif kind == "attempt_end":
             status = data.get("status", "?")
             key = (int(tid), int(ev.get("attempt") or 0), status)
@@ -542,6 +633,21 @@ def run_summary(
         ),
         "device_books": {k: books[k] for k in sorted(books)},
         "anomalies": fold.anomalies,
+        # Compile books (docs/COMPILE.md): per-program compile-seconds
+        # and registry hits, the farm's lifecycle counters, and every
+        # admission's latency/outcome — the cold-start accounting the
+        # coldstart bench and the console read.
+        "compile": {
+            "programs": {
+                k: fold.compile_books[k]
+                for k in sorted(fold.compile_books)
+            },
+            "compiles": fold.compiles,
+            "compile_s_total": fold.compile_s_total,
+            "cache_hits": fold.cache_hits,
+            "precompile": dict(sorted(fold.precompile.items())),
+            "admissions": fold.admissions,
+        },
     }
     if registry is not None:
         out["metrics"] = registry.snapshot()

@@ -17,9 +17,28 @@ the cache-read path — the deserialized executable's first few runs die
 in ``malloc: chunk_main_arena`` / SIGSEGV (this was the seed suite's
 ``test_resume_continues_from_checkpoint`` abort that killed every test
 after ``test_hpo.py``). A corrupted process loses whole artifacts and
-test runs; a cold compile only loses seconds — so the cache is now
-opt-in via ``MDT_FORCE_COMPILE_CACHE=1`` for environments whose jaxlib
-serializes CPU executables correctly.
+test runs; a cold compile only loses seconds.
+
+Two opt-in paths exist now:
+
+- ``MDT_FORCE_COMPILE_CACHE=1`` — the raw escape hatch for
+  environments whose jaxlib serializes CPU executables correctly
+  ("I am the canary"). This module's :func:`cache_is_safe` gate.
+- **The safe path** (docs/COMPILE.md):
+  ``multidisttorch_tpu.compile.cache.enable_quarantined_cache`` — a
+  CRC-sidecar scan over every entry, a subprocess canary-execute
+  protocol (a sacrificial child must deserialize, run, and bit-match
+  a cold-compiled reference before this process touches the cache),
+  and a backend gate (TPU enables on a passed canary; XLA:CPU stays
+  quarantined-only — deserialized CPU executables run only in
+  processes marked ``MDT_CACHE_SACRIFICIAL=1``). The coldstart bench
+  (``bench.py --coldstart``) measures the win behind a bit-parity
+  gate; ``tools/preflight.py --compile-cache`` probes cache health
+  without enabling anything.
+
+This module stays the shared *mechanism* (cache dir resolution, the
+raw config flip); the quarantine layer is the *policy* that makes
+enabling it sane on this toolchain.
 """
 
 from __future__ import annotations

@@ -44,6 +44,21 @@ Everything folds to ONE verdict from a closed taxonomy
 - ``init_failed`` / ``canary_failed`` — non-timeout failures with the
   error recorded.
 
+An optional **compile_cache** stage (``compile_cache=True`` /
+``tools/preflight.py --compile-cache``) probes the quarantined
+persistent executable cache the same bounded, out-of-process way
+(docs/COMPILE.md): a CRC sidecar scan over the cache dir plus ONE
+cold/warmup/warm canary protocol run in sacrificial children — both
+read-only (rejects reported, nothing quarantined or evicted: a
+diagnostic must not discard a production cache on a transient
+failure). Its
+verdict rides the report as ``compile_cache.verdict`` using the cache
+layer's own closed taxonomy (``passed`` / ``canary_mismatch`` /
+``canary_crashed`` / ``canary_timeout``) — cache state is orthogonal
+to backend usability, so it refines the report without ever flipping
+a healthy backend verdict (a cache nobody can trust just means cold
+compiles, exactly as safe as the cache staying off).
+
 No jax import in THIS process, ever: a wedged plugin must never take
 the prober down with it. Verdicts are emitted on the telemetry bus
 (``preflight_start`` / ``preflight_stage`` / ``preflight_verdict``)
@@ -419,6 +434,8 @@ def run_preflight(
     canary: bool = True,
     canary_timeout_s: int = CANARY_TIMEOUT_S,
     scan: bool = True,
+    compile_cache: bool = False,
+    compile_cache_dir: Optional[str] = None,
 ) -> dict:
     """The full structured probe: bounded init → (on failure: /proc
     evidence scan + one delayed retry) → enumeration → compile/execute
@@ -549,6 +566,38 @@ def run_preflight(
             verdict = INIT_FAILED
             reason = str(failed.get("error", "init failed"))
 
+    cache_report = None
+    if compile_cache and probe["ok"]:
+        # Only a usable backend can run the cache canary's sacrificial
+        # children; on a wedged/absent backend the cache question is
+        # moot (nothing will compile either way).
+        from multidisttorch_tpu.compile.cache import cache_probe
+
+        t_cache = time.perf_counter()
+        cp = cache_probe(
+            compile_cache_dir,
+            platform=platform,
+            canary=True,
+        )
+        can = cp.get("canary") or {}
+        cache_report = {
+            "cache_dir": cp["cache_dir"],
+            "verdict": can.get("verdict", "scan_only"),
+            "usable": bool(cp.get("usable")),
+            "scan": cp.get("scan"),
+            "evicted": can.get("evicted", 0),
+        }
+        stage(
+            "compile_cache",
+            {
+                "ok": bool(cp.get("usable")),
+                "elapsed_s": round(time.perf_counter() - t_cache, 2),
+                "cache_verdict": cache_report["verdict"],
+                "scanned": (cp.get("scan") or {}).get("checked"),
+                "rejected": len((cp.get("scan") or {}).get("rejected") or []),
+            },
+        )
+
     elapsed = round(time.perf_counter() - t0, 2)
     usable = verdict in USABLE_VERDICTS
     _emit(
@@ -570,4 +619,5 @@ def run_preflight(
         "device": device,
         "memory_stats": memory_stats,
         "triage": triage,
+        "compile_cache": cache_report,
     }

@@ -60,6 +60,12 @@ def render(report: dict) -> str:
             )
         elif st["stage"] == "canary" and st.get("ok"):
             extra = f" value={st.get('canary_value')}"
+        elif st["stage"] == "compile_cache":
+            extra = (
+                f" verdict={st.get('cache_verdict')} "
+                f"scanned={st.get('scanned')} "
+                f"rejected={st.get('rejected')}"
+            )
         el = st.get("elapsed_s")
         lines.append(
             f"  {st['stage']:<12} {ok:<4}"
@@ -102,6 +108,18 @@ def main(argv=None) -> int:
     parser.add_argument("--no-scan", action="store_true",
                         help="skip the /proc leaked-plugin scan")
     parser.add_argument(
+        "--compile-cache", action="store_true",
+        help="also probe the quarantined persistent executable cache: "
+        "CRC sidecar scan + one subprocess canary protocol run "
+        "(docs/COMPILE.md); the cache verdict rides the report, "
+        "orthogonal to backend usability",
+    )
+    parser.add_argument(
+        "--cache-dir", default=None,
+        help="cache directory for --compile-cache "
+        "(default: the shared utils/compile_cache.py resolution)",
+    )
+    parser.add_argument(
         "--telemetry-dir", default=None,
         help="stream preflight_* events to {dir}/events.jsonl",
     )
@@ -119,6 +137,8 @@ def main(argv=None) -> int:
         canary=not args.no_canary,
         canary_timeout_s=int(args.canary_timeout),
         scan=not args.no_scan,
+        compile_cache=args.compile_cache,
+        compile_cache_dir=args.cache_dir,
     )
     if args.telemetry_dir:
         from multidisttorch_tpu import telemetry

@@ -89,6 +89,14 @@ def snapshot(state: SweepFold, path: str) -> dict:
         "anomalies": state.anomalies,
         "trials": {k: state.trials[k] for k in sorted(state.trials)},
         "device_books": {k: state.device[k] for k in sorted(state.device)},
+        "compile_books": {
+            k: state.compile_books[k] for k in sorted(state.compile_books)
+        },
+        "compiles": state.compiles,
+        "compile_s_total": state.compile_s_total,
+        "cache_hits": state.cache_hits,
+        "precompile": dict(sorted(state.precompile.items())),
+        "admissions": state.admissions,
     }
 
 
@@ -151,6 +159,12 @@ def render(state: SweepFold, path: str) -> str:
                 fmt_mfu(live_mfu(state, tid, rate)),
                 fmt_bytes(book.get("peak_bytes")),
                 t.get("anomalies", 0) or "-",
+                (
+                    f"{t['admission_s']:.2f}s"
+                    if t.get("admission_s") is not None
+                    else "-"
+                ),
+                t.get("compile_outcome") or "-",
                 fmt_duration(wall),
             ]
         )
@@ -159,9 +173,50 @@ def render(state: SweepFold, path: str) -> str:
             rows,
             ["trial", "status", "att", "epoch", "steps", "step rate",
              "train loss", "test loss", "retries", "faults", "lane",
-             "mfu", "peak mem", "anom", "wall"],
+             "mfu", "peak mem", "anom", "admit", "compile", "wall"],
         )
     )
+    if state.compile_books:
+        # Per-program compile books (docs/COMPILE.md): where the
+        # sweep's compile-seconds went, how they were paid (farm
+        # thread vs inline admission), and how often the registry
+        # served an executable instead of XLA.
+        lines.append("")
+        lines.append(
+            "compile  total {n} ({s:.2f}s)  registry hits {h}".format(
+                n=state.compiles,
+                s=state.compile_s_total,
+                h=state.cache_hits,
+            )
+            + (
+                "  farm " + " ".join(
+                    f"{k}:{v}"
+                    for k, v in sorted(state.precompile.items())
+                )
+                if state.precompile
+                else ""
+            )
+        )
+        crows = []
+        for prog in sorted(state.compile_books):
+            b = state.compile_books[prog]
+            crows.append(
+                [
+                    prog,
+                    b.get("source") or "-",
+                    b["compiles"],
+                    f"{b['compile_s']:.2f}s",
+                    b["hits"],
+                    "ok" if b.get("ok", True) else "FAILED",
+                ]
+            )
+        lines.append(
+            fmt_table(
+                crows,
+                ["program", "source", "compiles", "compile s",
+                 "hits", "status"],
+            )
+        )
     return "\n".join(lines)
 
 
