@@ -627,6 +627,170 @@ def _lm_train_flops_per_token(
     return 3.0 * fwd
 
 
+PBT_BENCH_POPULATION = 4
+PBT_BENCH_GENERATIONS = 4
+PBT_BENCH_STEPS_PER_GEN = 10
+PBT_BENCH_BATCH = 64
+
+
+def bench_pbt() -> dict:
+    """Fused-lane vs per-submesh PBT A/B on the VAE workload.
+
+    The artifact the fused population mode is judged by (ISSUE 8
+    acceptance): the SAME population — same seeds, same data streams,
+    same explore draws (the docs/PBT.md seeding contract) — run once as
+    K members on K submeshes with host-side exploit/explore
+    (``run_pbt(fused=False)``) and once as K lanes of one fused
+    generation program (``fused=True``) on a submesh of the SAME shape
+    (group 0 of the same carving, so the two legs' programs are
+    bit-comparable). Banks dispatches/generation and wall-clock/
+    generation for both legs, the headline dispatch-reduction ratio
+    (floor: >= 3x at K=4), bit-parity of the whole population
+    trajectory (per-generation loss sums, ranking, exploit edges, AND
+    final member states — stronger than the best-member floor the
+    acceptance names), and the compile-registry evidence that the
+    ``pbt_gen`` program compiled ONCE with a cache_hit on every later
+    generation. Wall-clock ratios are recorded, not gated: virtual CPU
+    devices time-share host cores (same caveat as --stacked).
+    """
+    import tempfile
+
+    from multidisttorch_tpu import telemetry as _telemetry
+    from multidisttorch_tpu.compile.registry import get_executable_registry
+    from multidisttorch_tpu.data.datasets import synthetic_mnist
+    from multidisttorch_tpu.hpo.pbt import PBTConfig, run_pbt
+    from multidisttorch_tpu.parallel.mesh import setup_groups
+    from multidisttorch_tpu.telemetry.events import EVENTS_NAME, read_events
+    from multidisttorch_tpu.telemetry.export import SweepFold
+
+    cfg = PBTConfig(
+        population=PBT_BENCH_POPULATION,
+        generations=PBT_BENCH_GENERATIONS,
+        steps_per_generation=PBT_BENCH_STEPS_PER_GEN,
+        batch_size=PBT_BENCH_BATCH,
+        hidden_dim=HIDDEN,
+        latent_dim=LATENT,
+        exploit_fraction=0.5,
+        lr_min=1e-4,
+        lr_max=1e-1,
+        seed=0,
+    )
+    train = synthetic_mnist(4096, seed=0)
+    # Eval set = one batch (E=1): the per-submesh leg's eval is then K
+    # dispatches/generation, the honest minimum — the fused leg folds
+    # even that into its one dispatch.
+    evals = synthetic_mnist(cfg.batch_size, seed=1)
+    groups = setup_groups(cfg.population)
+
+    ref = run_pbt(
+        cfg, train, evals, groups=groups, verbose=False,
+        return_states=True,
+    )
+    tel_dir = tempfile.mkdtemp(prefix="bench_pbt_tel_")
+    with _telemetry.telemetry_run(tel_dir):
+        fus = run_pbt(
+            cfg, train, evals, groups=[groups[0]], fused=True,
+            verbose=False, return_states=True,
+        )
+        events = read_events(os.path.join(tel_dir, EVENTS_NAME))
+    fold = SweepFold()
+    for ev in events:
+        fold.feed(ev)
+
+    # --- bit-parity of the population trajectory across the two legs
+    mismatches = []
+    for g in range(cfg.generations):
+        r, f = ref.history[g], fus.history[g]
+        for field in ("loss_sums", "order", "exploits"):
+            if r[field] != f[field]:
+                mismatches.append(
+                    {"generation": g, "field": field,
+                     "submesh": r[field], "fused": f[field]}
+                )
+    best_trajectory = [
+        {"generation": g, "best": h["order"][0],
+         "best_loss_sum": h["loss_sums"][h["order"][0]]}
+        for g, h in enumerate(ref.history)
+    ]
+    states_equal = True
+    for k in range(cfg.population):
+        for a, b in zip(
+            jax.tree.leaves(ref.final_states[k]),
+            jax.tree.leaves(fus.final_states[k]),
+        ):
+            if not np.array_equal(
+                np.asarray(a), np.asarray(b), equal_nan=True
+            ):
+                states_equal = False
+                mismatches.append({"member": k, "field": "final_state"})
+                break
+    parity = not mismatches
+
+    # --- compile-registry evidence: the pbt_gen program is in the
+    # per-program table with ONE compile and a cache_hit per later
+    # generation (the process-lifetime registry, PR 7).
+    snap = get_executable_registry().snapshot()
+    pbt_programs = {
+        label: v for label, v in snap.items()
+        if label.startswith("pbt_gen")
+    }
+    registry_ok = any(
+        v["status"] == "ready" and v["hits"] >= cfg.generations - 1
+        for v in pbt_programs.values()
+    )
+    compiles_ok = all(
+        b["compiles"] == 1
+        for p, b in fold.compile_books.items()
+        if p.startswith("pbt_gen")
+    ) and any(p.startswith("pbt_gen") for p in fold.compile_books)
+
+    ref_dpg = ref.dispatch_book["dispatches_per_generation"]
+    fus_dpg = fus.dispatch_book["dispatches_per_generation"]
+    gens = max(1, cfg.generations)
+    return {
+        "config": {
+            "population": cfg.population,
+            "generations": cfg.generations,
+            "steps_per_generation": cfg.steps_per_generation,
+            "batch_size": cfg.batch_size,
+            "hidden_dim": cfg.hidden_dim,
+            "latent_dim": cfg.latent_dim,
+            "exploit_fraction": cfg.exploit_fraction,
+            "eval_batches": 1,
+            "submesh_devices": groups[0].size,
+        },
+        "submesh": {
+            "dispatch_book": ref.dispatch_book,
+            "wall_s": round(ref.wall_s, 3),
+            "wall_s_per_generation": round(ref.wall_s / gens, 3),
+        },
+        "fused": {
+            "dispatch_book": fus.dispatch_book,
+            "wall_s": round(fus.wall_s, 3),
+            "wall_s_per_generation": round(fus.wall_s / gens, 3),
+        },
+        # the headline: K train + K eval dispatches + per-exploit host
+        # round-trips per generation, collapsed into one dispatch
+        "dispatch_reduction": round(ref_dpg / fus_dpg, 3),
+        "wall_ratio_submesh_over_fused": (
+            round(ref.wall_s / fus.wall_s, 3) if fus.wall_s else None
+        ),
+        "parity": parity,
+        "parity_mismatches": mismatches[:10],
+        "final_states_bit_identical": states_equal,
+        "best_member_trajectory": best_trajectory,
+        "exploits_total": sum(
+            len(h["exploits"]) for h in ref.history
+        ),
+        "compile_registry": {
+            "programs": pbt_programs,
+            "one_compile_cache_hit_gen2plus": registry_ok,
+            "compile_books_one_compile": compiles_ok,
+        },
+        "population_view": fold.pbt,
+    }
+
+
 def bench_lm() -> dict:
     """Transformer-LM training throughput + MFU on one chip.
 
@@ -1491,6 +1655,15 @@ def main():
         "(docs/RESILIENCE.md \"Elastic multi-host\")",
     )
     parser.add_argument(
+        "--pbt", action="store_true",
+        help="A/B fused-lane PBT (whole generation = one dispatch of "
+        "the registered pbt_gen program) vs per-submesh PBT on the VAE "
+        "workload: dispatches/generation, wall/generation, bit-parity "
+        "of the population trajectory, and the compile-registry "
+        "one-compile evidence (docs/PBT.md; banks "
+        "artifacts/bench_pbt_*.json)",
+    )
+    parser.add_argument(
         "--coldstart", action="store_true",
         help="measure cold vs precompiled (AOT farm) vs cache-warm "
         "(quarantined persistent cache) trial-admission latency over a "
@@ -1509,12 +1682,13 @@ def main():
     if sum(x is not None and x is not False
            for x in (args.concurrency, args.to_elbo, args.loader,
                      args.lm, args.suite, args.decode, args.stacked,
-                     args.chaos, args.chaos_mh, args.coldstart)) > 1:
+                     args.chaos, args.chaos_mh, args.coldstart,
+                     args.pbt)) > 1:
         parser.error("--concurrency/--to-elbo/--loader/--lm/--decode/"
-                     "--suite/--stacked/--chaos/--chaos-mh/--coldstart "
-                     "are mutually exclusive")
+                     "--suite/--stacked/--chaos/--chaos-mh/--coldstart/"
+                     "--pbt are mutually exclusive")
 
-    if (args.stacked or args.chaos or args.chaos_mh) and \
+    if (args.stacked or args.chaos or args.chaos_mh or args.pbt) and \
             "xla_force_host_platform_device_count" not in (
         os.environ.get("XLA_FLAGS", "")
     ):
@@ -1811,6 +1985,61 @@ def main():
                     "fleet_summary": fleet["banked_paths"].get(
                         "summary", fleet["paths"].get("summary")
                     ),
+                    "detail": r,
+                }
+            )
+        )
+        return
+
+    if args.pbt:
+        r = bench_pbt()
+        r["backend"] = backend
+        # Bank the artifact (ISSUE 8 acceptance): timestamped file so a
+        # later degraded run never clobbers banked evidence, plus a
+        # _latest alias for the CI gate/console — same policy as
+        # --coldstart.
+        banked = None
+        try:
+            os.makedirs("artifacts", exist_ok=True)
+            stamp = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
+            platform = backend.get("platform", "cpu")
+            banked = f"artifacts/bench_pbt_{platform}_{stamp}.json"
+            tmp = banked + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(r, f, indent=1)
+            os.replace(tmp, banked)
+            latest = "artifacts/bench_pbt_latest.json"
+            with open(latest + ".tmp", "w") as f:
+                json.dump({**r, "banked_as": banked}, f, indent=1)
+            os.replace(latest + ".tmp", latest)
+        except OSError as e:
+            print(f"artifact banking failed: {e!r}", file=sys.stderr)
+            banked = None
+        print(
+            json.dumps(
+                {
+                    "metric": "pbt_fused_dispatch_reduction",
+                    "value": r["dispatch_reduction"],
+                    "unit": "x fewer dispatches/generation (fused vs "
+                    "per-submesh)",
+                    # acceptance floor: >= 3x at K=4 with bit-identical
+                    # trajectory
+                    "vs_baseline": (
+                        round(r["dispatch_reduction"] / 3.0, 3)
+                        if r["dispatch_reduction"] is not None
+                        else None
+                    ),
+                    "parity": r["parity"],
+                    "final_states_bit_identical": r[
+                        "final_states_bit_identical"
+                    ],
+                    "registry_one_compile_cache_hit": r[
+                        "compile_registry"
+                    ]["one_compile_cache_hit_gen2plus"],
+                    "wall_ratio_submesh_over_fused": r[
+                        "wall_ratio_submesh_over_fused"
+                    ],
+                    "banked_as": banked,
                     "detail": r,
                 }
             )

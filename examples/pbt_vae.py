@@ -1,9 +1,15 @@
-"""Population-based training of VAEs across submeshes (BASELINE.md
-config 5: "inter-subgroup weight broadcast/exploit across submeshes").
+"""Population-based training of VAEs (BASELINE.md config 5:
+"inter-subgroup weight broadcast/exploit across submeshes").
 
 Run (8 virtual CPU devices, population of 4 on 2-device submeshes):
     XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
         python examples/pbt_vae.py --population 4 --generations 3
+
+``--fused`` runs the population as K lanes of ONE vmapped program on a
+single submesh instead: a whole generation (train + eval +
+exploit/explore) is one dispatch of the registered ``pbt_gen`` program
+(docs/PBT.md), bit-identical to the per-submesh mode under the shared
+seeding contract.
 """
 
 import argparse
@@ -24,6 +30,12 @@ def main():
     parser.add_argument("--batch-size", type=int, default=128)
     parser.add_argument("--out-dir", default="results-pbt")
     parser.add_argument("--synthetic-size", type=int, default=None)
+    parser.add_argument(
+        "--fused", action="store_true",
+        help="run the population as lanes of one fused generation "
+        "program (one dispatch per generation) instead of one member "
+        "per submesh",
+    )
     args = parser.parse_args()
 
     mdt.initialize_runtime()
@@ -39,12 +51,16 @@ def main():
         steps_per_generation=args.steps_per_generation,
         batch_size=args.batch_size,
     )
-    result = run_pbt(cfg, train_data, eval_data, out_dir=args.out_dir)
+    result = run_pbt(
+        cfg, train_data, eval_data, out_dir=args.out_dir, fused=args.fused
+    )
+    book = result.dispatch_book
     print(
-        f"best member {result.best_member}: eval loss "
+        f"[{result.mode}] best member {result.best_member}: eval loss "
         f"{result.best_eval_loss:.2f}; final lrs "
         f"{['%.1e' % lr for lr in result.final_lrs]}; "
-        f"wall {result.wall_s:.1f}s"
+        f"wall {result.wall_s:.1f}s; "
+        f"{book.get('dispatches_per_generation')} dispatches/gen"
     )
 
 

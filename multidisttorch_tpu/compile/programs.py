@@ -58,6 +58,9 @@ SINGLE_MULTI = "multi"
 SINGLE_INIT = "init"
 STACKED_TRAIN = "stacked_train"
 STACKED_MULTI = "stacked_multi"
+# One whole PBT generation (S-step train scan + E-batch eval scan +
+# in-program lane exchange) as one program: hpo/pbt.py's fused path.
+PBT_GEN = "pbt_gen"
 
 
 def mesh_fingerprint(trial: TrialMesh) -> tuple:
@@ -111,6 +114,41 @@ def stacked_multi_key(
     return (STACKED_MULTI, bucket_key, int(lanes), mesh_fingerprint(trial))
 
 
+def pbt_gen_key(
+    trial: TrialMesh,
+    bucket_key: tuple,
+    *,
+    lanes: int,
+    steps_per_generation: int,
+    eval_batches: int,
+    n_exploit: int,
+    perturb_factors,
+    lr_min: float,
+    lr_max: float,
+) -> tuple:
+    """The fused PBT generation program's key. Like the stacked train
+    keys, per-lane lr/beta ride in as ``(K,)`` arrays, so hypers stay
+    OUT; what XLA bakes in as constants here is the population
+    *protocol* — lane count, scan lengths (train steps + eval batches),
+    the exploit slot count, and the explore factor table / lr clip
+    bounds inside :func:`~multidisttorch_tpu.train.steps.pbt_exchange`
+    — so two populations sharing the protocol share one executable."""
+    return (
+        PBT_GEN,
+        bucket_key,
+        (
+            int(lanes),
+            int(steps_per_generation),
+            int(eval_batches),
+            int(n_exploit),
+            tuple(float(f) for f in perturb_factors),
+            float(lr_min),
+            float(lr_max),
+        ),
+        mesh_fingerprint(trial),
+    )
+
+
 def program_label(key: tuple) -> str:
     """Human-readable program name for events/metrics/console — the
     bucket signature, lane count or hypers, and the anchor device, in
@@ -133,6 +171,9 @@ def _program_label(key: tuple) -> str:
         sig += "-rm"
     if kind in (STACKED_TRAIN, STACKED_MULTI):
         sig += f"-K{extra}"
+    elif kind == PBT_GEN:
+        lanes, spg, ebatches, n_exploit = extra[:4]
+        sig += f"-K{lanes}-S{spg}-E{ebatches}-x{n_exploit}"
     elif kind == SINGLE_INIT:
         pass  # init bakes no hypers — lr/beta twins share it
     else:
@@ -292,3 +333,65 @@ def build_stacked_steps(
         else None
     )
     return {"train": train, "multi": multi}
+
+
+def pbt_gen_avals(
+    model: VAE,
+    *,
+    lanes: int,
+    steps_per_generation: int,
+    eval_batches: int,
+    batch_size: int,
+) -> tuple:
+    """Argument avals for the fused PBT generation program:
+    ``(state, hypers, batches, eval_batches, eval_weights, base_rngs,
+    lane_steps, gen, explore_key)`` — state/hypers/rngs shaped by the
+    same constructors ``hpo/pbt.py`` materializes real arrays with."""
+    lanes = int(lanes)
+    state = jax.eval_shape(
+        lambda: build_stacked_train_state(model, list(range(lanes)))
+    )
+    hypers = jax.eval_shape(
+        lambda: TrialHypers.stack([1e-3] * lanes, [1.0] * lanes)
+    )
+    base_rngs = jax.eval_shape(
+        lambda: jnp.stack([jax.random.key(i) for i in range(lanes)])
+    )
+    batches = jax.ShapeDtypeStruct(
+        (steps_per_generation, lanes, batch_size, model.input_dim),
+        jnp.float32,
+    )
+    eval_b = jax.ShapeDtypeStruct(
+        (eval_batches, batch_size, model.input_dim), jnp.float32
+    )
+    eval_w = jax.ShapeDtypeStruct((eval_batches, batch_size), jnp.float32)
+    lane_steps = jax.ShapeDtypeStruct((lanes,), jnp.int32)
+    gen = jax.ShapeDtypeStruct((), jnp.int32)
+    return (
+        state, hypers, batches, eval_b, eval_w, base_rngs, lane_steps,
+        gen, _rng_aval(),
+    )
+
+
+def build_pbt_generation(
+    trial: TrialMesh,
+    model: VAE,
+    *,
+    n_exploit: int,
+    perturb_factors,
+    lr_min: float,
+    lr_max: float,
+):
+    """The fused PBT generation jit fn — the exact factory call the
+    fused ``run_pbt`` path makes
+    (:func:`~multidisttorch_tpu.train.steps.make_pbt_generation_step`)."""
+    from multidisttorch_tpu.train.steps import make_pbt_generation_step
+
+    return make_pbt_generation_step(
+        trial,
+        model,
+        n_exploit=int(n_exploit),
+        perturb_factors=tuple(float(f) for f in perturb_factors),
+        lr_min=float(lr_min),
+        lr_max=float(lr_max),
+    )

@@ -19,6 +19,9 @@ SURVEY.md §2d:
 
 from __future__ import annotations
 
+import os
+import queue as _queue
+import threading
 from typing import Callable, Iterator, Optional
 
 import jax
@@ -26,6 +29,67 @@ import numpy as np
 
 from multidisttorch_tpu.data.datasets import Dataset
 from multidisttorch_tpu.parallel.mesh import TrialMesh
+
+
+def _prefetch_default() -> bool:
+    """The stacked host-gather prefetch's env kill switch: ON unless
+    ``MDT_STACKED_PREFETCH=0`` (docs/PBT.md bench protocol — the
+    off-path is the bit-parity reference and the fallback if a
+    platform's threading misbehaves)."""
+    return os.environ.get("MDT_STACKED_PREFETCH", "1") != "0"
+
+
+def _prefetched(produce: Callable[[int], np.ndarray], n: int) -> Iterator:
+    """Double-buffer a host-side batch producer: a daemon worker runs
+    ``produce(b)`` for ``b`` in ``range(n)`` one gather AHEAD of the
+    consumer (1-slot queue + the in-flight item = two buffers), so the
+    next stacked gather overlaps the current device dispatch. Yields
+    ``(b, item)`` in order; a producer exception re-raises at the
+    consumer's ``next()``; abandoning the generator (consumer raise /
+    close) unblocks and retires the worker via the stop flag."""
+    q: _queue.Queue = _queue.Queue(maxsize=1)
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for b in range(n):
+                item = (b, produce(b))
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except _queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            while not stop.is_set():
+                try:
+                    q.put(None, timeout=0.1)  # end-of-stream sentinel
+                    return
+                except _queue.Full:
+                    continue
+        except BaseException as e:  # noqa: BLE001 — surface at next()
+            while not stop.is_set():
+                try:
+                    q.put(("__error__", e), timeout=0.1)
+                    return
+                except _queue.Full:
+                    continue
+
+    t = threading.Thread(
+        target=worker, name="mdt-stacked-prefetch", daemon=True
+    )
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            if isinstance(item, tuple) and item[0] == "__error__":
+                raise item[1]
+            yield item
+    finally:
+        stop.set()
 
 
 def epoch_permutation(seed: int, epoch: int, indices: np.ndarray) -> np.ndarray:
@@ -305,6 +369,7 @@ class StackedTrialDataIterator:
         *,
         use_native: Optional[bool] = None,
         fault_hook: Optional[Callable] = None,
+        prefetch: Optional[bool] = None,
     ):
         if batch_size % trial.data_size != 0:
             raise ValueError(
@@ -334,6 +399,16 @@ class StackedTrialDataIterator:
         # (the vmapped program keeps lanes independent, so a poisoned
         # lane diverges alone). Must preserve shape/dtype.
         self.fault_hook = fault_hook
+        # Host-gather prefetch (numpy path only — the native gatherer
+        # already overlaps on its own C++ thread): the round's NEXT
+        # (K, B, ...) fancy-index gather runs on a background thread
+        # while the current batch's device transfer + dispatch are in
+        # flight. None → on unless the MDT_STACKED_PREFETCH=0 kill
+        # switch; bit-parity with the inline path is regression-tested
+        # (same permutations, same order — only the overlap differs).
+        self._prefetch = (
+            _prefetch_default() if prefetch is None else bool(prefetch)
+        )
         self._use_native = False
         if use_native is not False:
             from multidisttorch_tpu.data import native
@@ -401,12 +476,26 @@ class StackedTrialDataIterator:
             finally:
                 g.close()
         else:
-            for b in range(self.num_batches):
+            def produce(b: int) -> np.ndarray:
                 idx = perms[:, b * bs : (b + 1) * bs].reshape(-1)
-                stacked = self.dataset.images[idx].reshape(k, bs, -1)
-                if self.fault_hook is not None:
-                    stacked = self.fault_hook(b, stacked)
-                yield stacked
+                return self.dataset.images[idx].reshape(k, bs, -1)
+
+            if self._prefetch and self.num_batches > 1:
+                # Double-buffered gathers; the fault hook stays HERE on
+                # the consumer side so injected faults fire at the same
+                # consumption point as the inline path (an injection
+                # raising one gather early would shift chaos-drill
+                # timelines).
+                for b, stacked in _prefetched(produce, self.num_batches):
+                    if self.fault_hook is not None:
+                        stacked = self.fault_hook(b, stacked)
+                    yield stacked
+            else:
+                for b in range(self.num_batches):
+                    stacked = produce(b)
+                    if self.fault_hook is not None:
+                        stacked = self.fault_hook(b, stacked)
+                    yield stacked
         self._advance_epochs()
 
     def round_batches(self) -> Iterator:
@@ -430,6 +519,32 @@ class StackedTrialDataIterator:
                 buf = []
         if buf:
             yield start, self._put(np.stack(buf), extra_leading=2)
+
+    def stream_chunks(self, k_steps: int) -> Iterator:
+        """Endless full ``[S, K, B, ...]`` chunks crossing round
+        boundaries (each round freshly permuted per lane) — the stacked
+        analog of :meth:`TrialDataIterator.stream_chunks`, and the feed
+        for *step-count-driven* stacked loops: fused PBT generations of
+        ``S`` optimizer steps (``hpo/pbt.py``), where round edges are
+        irrelevant and every chunk must be full so the fused generation
+        program compiles exactly once. Lane ``k`` replays exactly the
+        stream a 1-lane iterator with ``seeds=[seeds[k]]`` yields — the
+        fused-vs-reference PBT parity contract."""
+        TrialDataIterator._check_chunk_size(k_steps)
+
+        def endless() -> Iterator[np.ndarray]:
+            while True:
+                yield from self._host_round()
+
+        def chunks():
+            buf = []
+            for stacked_np in endless():
+                buf.append(stacked_np)
+                if len(buf) == k_steps:
+                    yield self._put(np.stack(buf), extra_leading=2)
+                    buf = []
+
+        return chunks()
 
 
 class EvalDataIterator:
@@ -487,22 +602,33 @@ class EvalDataIterator:
         pad_width = [(0, short)] + [(0, 0)] * (arr.ndim - 1)
         return np.pad(arr, pad_width)
 
-    def batches(self) -> Iterator:
-        """Yield ``(imgs, weights)`` (or ``(imgs, labels, weights)``)
-        device-ready tuples; weights are 1.0 on real rows, 0.0 on the
-        final batch's padding."""
+    def host_batches(self) -> Iterator:
+        """Yield host-side ``(imgs_np, labels_np_or_None, weights_np)``
+        padded batches — the single source :meth:`batches` places on
+        device, also consumed whole by the fused PBT path
+        (``hpo/pbt.py`` stacks the full eval set into one ``(E, B, ...)``
+        device array scanned inside the generation program)."""
         bs = self.batch_size
         for b in range(self.num_batches):
             rows = self.dataset.images[b * bs : (b + 1) * bs]
             n_real = rows.shape[0]
             weights = np.zeros(bs, np.float32)
             weights[:n_real] = 1.0
-            imgs = self._put(self._pad(rows))
+            labels = (
+                self._pad(self.dataset.labels[b * bs : (b + 1) * bs])
+                if self.with_labels
+                else None
+            )
+            yield self._pad(rows), labels, weights
+
+    def batches(self) -> Iterator:
+        """Yield ``(imgs, weights)`` (or ``(imgs, labels, weights)``)
+        device-ready tuples; weights are 1.0 on real rows, 0.0 on the
+        final batch's padding."""
+        for imgs_np, labels_np, weights in self.host_batches():
+            imgs = self._put(imgs_np)
             if self.with_labels:
-                labels = self._pad(
-                    self.dataset.labels[b * bs : (b + 1) * bs]
-                )
-                yield imgs, self._put(labels), self._put(weights)
+                yield imgs, self._put(labels_np), self._put(weights)
             else:
                 yield imgs, self._put(weights)
 

@@ -188,6 +188,22 @@ class TrialMesh:
             return jax.device_put(tree, sh)
 
         def put_leaf(x, leaf_sh):
+            dt = getattr(x, "dtype", None)
+            if dt is not None and jax.dtypes.issubdtype(
+                dt, jax.dtypes.prng_key
+            ):
+                # Typed PRNG keys (PBT base_rngs, the explore key)
+                # cannot round-trip through np.asarray: place the raw
+                # uint32 key data and rewrap. Keys only ever place
+                # replicated here, and a replicated spec is
+                # rank-agnostic, so the same sharding serves the key
+                # data's extra trailing dim.
+                impl = jax.random.key_impl(x)
+                data = np.asarray(jax.random.key_data(x))
+                placed = jax.make_array_from_callback(
+                    data.shape, leaf_sh, lambda idx: data[idx]
+                )
+                return jax.random.wrap_key_data(placed, impl=impl)
             x = np.asarray(x)
             return jax.make_array_from_callback(
                 x.shape, leaf_sh, lambda idx: x[idx]

@@ -325,6 +325,11 @@ class SweepFold:
         self.compile_s_total = 0.0
         self.precompile: dict[str, int] = {}
         self.admissions: list[dict] = []
+        # Population books folded off the pbt_* events (hpo/pbt.py):
+        # mode/population once, one row per generation (best/median
+        # loss, exploit count, rank churn, lr quantiles) — the console
+        # and --json's population view.
+        self.pbt: dict = {}
         # Fleet tags (host slot -> event count) — empty on an untagged
         # single-host stream; the fleet console folds a merged stream
         # through the same class.
@@ -440,6 +445,34 @@ class SweepFold:
         elif kind.startswith("precompile_"):
             short = kind[len("precompile_"):]
             self.precompile[short] = self.precompile.get(short, 0) + 1
+        elif kind == "pbt_gen":
+            data = ev.get("data") or {}
+            self.pbt["mode"] = data.get("mode", self.pbt.get("mode"))
+            self.pbt["population"] = data.get(
+                "population", self.pbt.get("population")
+            )
+            gens = self.pbt.setdefault("generations", {})
+            gens[int(data.get("generation", len(gens)))] = {
+                k: data.get(k)
+                for k in (
+                    "best_lane", "best_loss", "median_loss",
+                    "exploit_count", "rank_churn", "lr_min", "lr_median",
+                    "lr_max",
+                )
+            }
+            self.pbt["exploit_total"] = self.pbt.get(
+                "exploit_total", 0
+            ) + int(data.get("exploit_count") or 0)
+        elif kind == "pbt_exploit":
+            data = ev.get("data") or {}
+            self.pbt.setdefault("exploits", []).append(
+                {
+                    "generation": data.get("generation"),
+                    "src": data.get("src"),
+                    "dst": data.get("dst"),
+                    "new_lr": data.get("new_lr"),
+                }
+            )
         elif kind == "first_dispatch" and ev.get("trial_id") is None:
             # The stacked bucket's admission (group-scoped; per-trial
             # first_dispatch falls through to the trial fold below).
@@ -649,6 +682,8 @@ def run_summary(
             "admissions": fold.admissions,
         },
     }
+    if fold.pbt:
+        out["pbt"] = fold.pbt
     if registry is not None:
         out["metrics"] = registry.snapshot()
     return out

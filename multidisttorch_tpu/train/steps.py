@@ -666,16 +666,13 @@ def make_stacked_multi_step(
     )
 
 
-def make_stacked_eval_step(trial: TrialMesh, model: VAE):
-    """Masked posterior-mean eval for K stacked trials in one dispatch:
-    ``eval(state, hypers, batch, weights) -> {'loss_sum': (K,)}`` — the
-    batch and its pad-mask weights are shared across lanes (every trial
-    scores the same test rows, reference contract), only the state and
-    beta are per-lane."""
+def _stacked_eval_lane(model: VAE):
+    """The per-lane masked posterior-mean eval body shared by
+    :func:`make_stacked_eval_step` and the fused PBT generation program
+    (:func:`make_pbt_generation_step`) — one copy, so a lane's eval loss
+    is bit-identical whether it is scored standalone or inside the
+    fused generation dispatch."""
     from multidisttorch_tpu.ops.losses import elbo_loss_weighted_sum
-
-    repl = trial.replicated_sharding
-    data = trial.batch_sharding
 
     def lane_eval(params, beta, batch, weights):
         n = batch.shape[0]
@@ -686,7 +683,73 @@ def make_stacked_eval_step(trial: TrialMesh, model: VAE):
             recon_logits, flat, mu, logvar, weights, beta
         ).astype(jnp.float32)
 
-    veval = jax.vmap(lane_eval, in_axes=(0, 0, None, None))
+    return lane_eval
+
+
+def _scan_eval_sums(veval, params, betas, eval_batches, eval_weights):
+    """Scan-accumulate the per-lane eval loss sums over ``(E, B, ...)``
+    stacked eval batches from a zero f32 carry — the ONE copy of the
+    eval reduction structure shared by :func:`make_stacked_eval_scan`
+    and the fused PBT generation program. Sharing the structure is a
+    bit-parity requirement, not a style choice: XLA fuses a scanned
+    reduction differently from a per-batch one (last-ulp reassociation,
+    measured on XLA:CPU at the flagship model size), so the per-submesh
+    reference path and the fused path must BOTH reduce through this
+    scan for their scores to stay bit-identical."""
+    k_lanes = betas.shape[0]
+
+    def ebody(acc, xs):
+        b, w = xs
+        return acc + veval(params, betas, b, w), None
+
+    sums, _ = jax.lax.scan(
+        ebody,
+        jnp.zeros((k_lanes,), jnp.float32),
+        (eval_batches, eval_weights),
+    )
+    return sums
+
+
+def make_stacked_eval_scan(trial: TrialMesh, model: VAE):
+    """Whole-eval-set masked eval for K stacked trials in ONE dispatch:
+    ``eval_scan(state, hypers, eval_batches, eval_weights) ->
+    {'loss_sum': (K,)}`` with ``eval_batches`` ``(E, B, ...)`` and
+    ``eval_weights`` ``(E, B)`` (dim 1 data-sharded, shared across
+    lanes) — the per-batch :func:`make_stacked_eval_step` folded over
+    the eval set on device. This is the PBT reference path's scorer:
+    structurally identical to the eval phase inside the fused
+    generation program (see :func:`_scan_eval_sums`)."""
+    repl = trial.replicated_sharding
+    eval_sh = trial.sharding(None, DATA_AXIS)
+    veval = jax.vmap(_stacked_eval_lane(model), in_axes=(0, 0, None, None))
+
+    def eval_fn(
+        state: TrainState, hypers: TrialHypers, eval_batches, eval_weights
+    ):
+        return {
+            "loss_sum": _scan_eval_sums(
+                veval, state.params, hypers.beta, eval_batches,
+                eval_weights,
+            )
+        }
+
+    return jax.jit(
+        eval_fn,
+        in_shardings=(repl, repl, eval_sh, eval_sh),
+        out_shardings=repl,
+    )
+
+
+def make_stacked_eval_step(trial: TrialMesh, model: VAE):
+    """Masked posterior-mean eval for K stacked trials in one dispatch:
+    ``eval(state, hypers, batch, weights) -> {'loss_sum': (K,)}`` — the
+    batch and its pad-mask weights are shared across lanes (every trial
+    scores the same test rows, reference contract), only the state and
+    beta are per-lane."""
+    repl = trial.replicated_sharding
+    data = trial.batch_sharding
+
+    veval = jax.vmap(_stacked_eval_lane(model), in_axes=(0, 0, None, None))
 
     def eval_fn(state: TrainState, hypers: TrialHypers, batch, weights):
         return {"loss_sum": veval(state.params, hypers.beta, batch, weights)}
@@ -733,6 +796,238 @@ def make_lane_ops(trial: TrialMesh):
         donate_argnums=(0,),
     )
     return read_j, write_j
+
+
+# --- fused PBT: exploit/explore as collectives over the lane axis ---
+#
+# The stacked lane axis (above) already runs K trials as one vmapped
+# program; population-based training adds one more per-generation op —
+# the exploit/explore exchange — and the pre-stacking PBT ran it
+# host-side: fetch every member's score, rank on the host, device_get/
+# device_put each exploited member's whole state across submeshes. Over
+# the lane axis the exchange is just lane-collectives (the DrJAX
+# population-as-mapped-axis construction, arXiv:2403.07128): a stable
+# argsort ranks lanes, a gather copies winners' params+opt-state into
+# losers' lanes, and a where perturbs the batched per-lane lr — so a
+# whole generation (train scan + eval scan + exchange) compiles into
+# ONE program and dispatches once, with no host round-trip per
+# exploited member. The explore perturbation is a PURE function of
+# (explore_key, generation, target lane) — the seeding contract that
+# lets the host-side reference path (hpo/pbt.py, fused=False) draw the
+# identical factors and stay bit-identical to the in-program exchange
+# (docs/PBT.md).
+
+# Domain-separation tag folded into key(seed) for the explore stream:
+# keeps perturbation draws disjoint from the param-init (key(seed+k))
+# and per-step data (key(seed+k+1)) streams, which share the seed space.
+PBT_EXPLORE_TAG = 0x9E3779B9
+
+
+def pbt_explore_key(seed: int) -> jax.Array:
+    """The population's explore stream root: every perturbation in a
+    PBT run (fused or host-side reference) derives from this one key,
+    so the two paths draw identical factors."""
+    return jax.random.fold_in(jax.random.key(seed), PBT_EXPLORE_TAG)
+
+
+def pbt_perturb_factor(
+    explore_key: jax.Array, gen, lane, perturb_factors: tuple
+) -> jnp.ndarray:
+    """The explore draw for (generation, target lane): a pure function
+    — ``fold_in(fold_in(explore_key, gen), lane)`` indexing the factor
+    table — identical eager (host reference path) and traced (inside
+    the fused generation program), which is the whole seeding contract.
+    ``gen``/``lane`` may be Python ints or traced int32 scalars."""
+    k = jax.random.fold_in(jax.random.fold_in(explore_key, gen), lane)
+    idx = jax.random.randint(k, (), 0, len(perturb_factors))
+    return jnp.asarray(perturb_factors, jnp.float32)[idx]
+
+
+def pbt_exchange(
+    state: TrainState,
+    hypers: TrialHypers,
+    eval_sums: jnp.ndarray,
+    gen,
+    explore_key: jax.Array,
+    *,
+    n_exploit: int,
+    perturb_factors: tuple,
+    lr_min: float,
+    lr_max: float,
+):
+    """The in-program exploit/explore over the lane axis.
+
+    ``eval_sums`` is the per-lane summed eval loss ``(K,)`` (f32; the
+    monotone rank statistic — dividing by the shared row count changes
+    no ordering). Ranking sanitizes NaN to ``+inf`` with a STABLE
+    argsort, so a diverged lane ranks strictly last (never a source)
+    and ties break by lane index — the same total order the host
+    reference path computes with ``np.argsort(kind='stable')``.
+
+    With ``n_exploit`` top/bottom slots (a static int, clamped by the
+    caller to ``K // 2`` so the slices can never overlap), bottom slot
+    ``i`` exploits top slot ``i`` iff its sanitized loss is strictly
+    worse: the whole per-lane TrainState (params, optimizer moments,
+    step) is GATHERED from the source lane, and the target lane's lr
+    becomes ``clip(lr[src] * factor, lr_min, lr_max)`` with the factor
+    drawn by :func:`pbt_perturb_factor`. Non-exploiting lanes pass
+    through untouched (gather from self). ``n_exploit == 0`` (the K=1
+    degenerate population) is the identity exchange.
+
+    Returns ``(state, hypers, stats)`` where ``stats`` carries
+    ``order`` (lanes best→worst), ``exploited`` (K,) bool, ``src``
+    (K,) int32 (self where not exploited), and ``new_lr`` (K,) f32 —
+    the host's books for telemetry and history, one fetch per
+    generation.
+    """
+    k_lanes = hypers.lr.shape[0]
+    sanitized = jnp.where(jnp.isnan(eval_sums), jnp.inf, eval_sums)
+    order = jnp.argsort(sanitized, stable=True).astype(jnp.int32)
+    lanes = jnp.arange(k_lanes, dtype=jnp.int32)
+    if n_exploit == 0:
+        stats = {
+            "order": order,
+            "exploited": jnp.zeros((k_lanes,), bool),
+            "src": lanes,
+            "new_lr": hypers.lr,
+        }
+        return state, hypers, stats
+    top = order[:n_exploit]
+    bottom = order[k_lanes - n_exploit:]
+    cond = sanitized[bottom] > sanitized[top]
+    src = lanes.at[bottom].set(jnp.where(cond, top, bottom))
+    exploited = jnp.zeros((k_lanes,), bool).at[bottom].set(cond)
+    factors = jax.vmap(
+        lambda lane: pbt_perturb_factor(
+            explore_key, gen, lane, perturb_factors
+        )
+    )(lanes)
+    new_lr = jnp.where(
+        exploited,
+        jnp.clip(jnp.take(hypers.lr, src) * factors, lr_min, lr_max),
+        hypers.lr,
+    )
+    new_state = jax.tree.map(lambda a: jnp.take(a, src, axis=0), state)
+    new_hypers = TrialHypers(
+        lr=new_lr, beta=hypers.beta, active=hypers.active
+    )
+    stats = {
+        "order": order,
+        "exploited": exploited,
+        "src": src,
+        "new_lr": new_lr,
+    }
+    return new_state, new_hypers, stats
+
+
+def make_pbt_generation_step(
+    trial: TrialMesh,
+    model: VAE,
+    *,
+    n_exploit: int,
+    perturb_factors: tuple,
+    lr_min: float,
+    lr_max: float,
+):
+    """ONE whole PBT generation as ONE compiled dispatch: an S-step
+    train scan over K stacked lanes (the exact
+    :func:`make_stacked_multi_step` body and RNG stream), an eval scan
+    over E shared pad-and-mask batches (the exact
+    :func:`make_stacked_eval_step` lane body), and the in-program
+    :func:`pbt_exchange` — where the pre-stacking PBT paid K train
+    dispatches + K·E eval dispatches + a host round-trip per exploited
+    member per generation.
+
+    Returns ``gen_step(state, hypers, batches, eval_batches,
+    eval_weights, base_rngs, lane_steps, gen, explore_key) ->
+    (state, hypers, stats)`` with ``batches`` of shape ``(S, K, B, ...)``
+    (dim 2 data-sharded), ``eval_batches``/``eval_weights`` of shape
+    ``(E, B, ...)``/``(E, B)`` shared across lanes, and ``gen`` a traced
+    int32 scalar — so one executable serves every generation (the
+    ``pbt_gen`` program kind, registered and AOT-compiled through
+    ``compile/programs.py``). ``stats`` carries per-step train losses
+    ``(S, K)``, per-lane eval loss sums ``(K,)``, and the exchange
+    books (:func:`pbt_exchange`).
+    """
+    lane_body = _stacked_lane_body(trial, model, remat=False, grad_accum=1)
+    vstep = jax.vmap(lane_body, in_axes=(0, 0, 0, 0, 0, 0))
+    veval = jax.vmap(_stacked_eval_lane(model), in_axes=(0, 0, None, None))
+    repl = trial.replicated_sharding
+    batches_sh = trial.sharding(None, None, DATA_AXIS)
+    eval_sh = trial.sharding(None, DATA_AXIS)
+
+    def gen_fn(
+        state: TrainState,
+        hypers: TrialHypers,
+        batches: jax.Array,
+        eval_batches: jax.Array,
+        eval_weights: jax.Array,
+        base_rngs: jax.Array,
+        lane_steps: jnp.ndarray,
+        gen: jnp.ndarray,
+        explore_key: jax.Array,
+    ):
+        def body(s, xs):
+            b, i = xs
+            rngs = _lane_fold_rngs(base_rngs, lane_steps + i)
+            s, loss_sums = vstep(
+                s, b, rngs, hypers.lr, hypers.beta, hypers.active
+            )
+            return s, loss_sums
+
+        state, train_losses = jax.lax.scan(
+            body,
+            state,
+            (batches, jnp.arange(batches.shape[0], dtype=jnp.int32)),
+        )
+
+        # Eval lane-SEQUENTIALLY at width 1 (lax.map over the lane
+        # axis), not as one width-K vmap: XLA's batched eval reduction
+        # at width K rounds the loss sum differently from the width-1
+        # program the per-submesh reference members run (last-ulp,
+        # measured at the flagship size on a sharded submesh), and the
+        # fused-vs-reference bit-parity contract pins the reference's
+        # arithmetic. Eval is a small fraction of a generation's FLOPs
+        # (E forward passes vs S forward+backward+update), so the
+        # sequential map costs little; the train scan stays width-K.
+        def eval_one(args):
+            p1, b1 = args
+            return _scan_eval_sums(
+                veval, p1, b1, eval_batches, eval_weights
+            )[0]
+
+        eval_sums = jax.lax.map(
+            eval_one,
+            (
+                jax.tree.map(lambda x: x[:, None], state.params),
+                hypers.beta[:, None],
+            ),
+        )
+
+        state, hypers_out, stats = pbt_exchange(
+            state,
+            hypers,
+            eval_sums,
+            gen,
+            explore_key,
+            n_exploit=n_exploit,
+            perturb_factors=perturb_factors,
+            lr_min=lr_min,
+            lr_max=lr_max,
+        )
+        stats["train_loss_sum"] = train_losses
+        stats["eval_loss_sum"] = eval_sums
+        return state, hypers_out, stats
+
+    return jax.jit(
+        gen_fn,
+        in_shardings=(
+            repl, repl, batches_sh, eval_sh, eval_sh, repl, repl, repl,
+            repl,
+        ),
+        out_shardings=(repl, repl, repl),
+        donate_argnums=(0, 1),
+    )
 
 
 def wrap_step_with_hooks(

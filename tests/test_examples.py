@@ -90,6 +90,17 @@ def test_pbt_example(tmp_path):
                 "--steps-per-generation", "4", "--synthetic-size", "512"],
                tmp=tmp_path)
     assert "best" in out.lower()
+    assert "[submesh]" in out
+
+
+@pytest.mark.examples
+def test_pbt_example_fused(tmp_path):
+    out = _run(["pbt_vae.py", "--population", "4", "--generations", "2",
+                "--steps-per-generation", "4", "--synthetic-size", "512",
+                "--fused"], tmp=tmp_path)
+    assert "[fused]" in out
+    # one fused generation program = one dispatch per generation
+    assert "1.0 dispatches/gen" in out
 
 
 @pytest.mark.examples

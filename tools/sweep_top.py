@@ -97,6 +97,10 @@ def snapshot(state: SweepFold, path: str) -> dict:
         "cache_hits": state.cache_hits,
         "precompile": dict(sorted(state.precompile.items())),
         "admissions": state.admissions,
+        # Population view (hpo/pbt.py's pbt_* events): mode, K, and the
+        # per-generation best/median loss, exploit count, and rank
+        # churn — {} when the stream carries no PBT run.
+        "pbt": state.pbt,
     }
 
 
@@ -215,6 +219,56 @@ def render(state: SweepFold, path: str) -> str:
                 crows,
                 ["program", "source", "compiles", "compile s",
                  "hits", "status"],
+            )
+        )
+    if state.pbt.get("generations"):
+        # Population view (docs/PBT.md): one row per PBT generation,
+        # folded from the pbt_gen events either mode emits.
+        lines.append("")
+        lines.append(
+            "population  mode {mode}  K={k}  exploits {x}".format(
+                mode=state.pbt.get("mode", "?"),
+                k=state.pbt.get("population", "?"),
+                x=state.pbt.get("exploit_total", 0),
+            )
+        )
+        prows = []
+        gens = state.pbt["generations"]
+        for g in sorted(gens):
+            row = gens[g]
+            prows.append(
+                [
+                    g,
+                    row.get("best_lane", "-"),
+                    (
+                        f"{row['best_loss']:.4f}"
+                        if row.get("best_loss") is not None
+                        else "-"
+                    ),
+                    (
+                        f"{row['median_loss']:.4f}"
+                        if row.get("median_loss") is not None
+                        else "-"
+                    ),
+                    row.get("exploit_count", 0),
+                    (
+                        f"{row['rank_churn']:.2f}"
+                        if row.get("rank_churn") is not None
+                        else "-"
+                    ),
+                    (
+                        f"{row['lr_min']:.2e}/{row['lr_median']:.2e}"
+                        f"/{row['lr_max']:.2e}"
+                        if row.get("lr_min") is not None
+                        else "-"
+                    ),
+                ]
+            )
+        lines.append(
+            fmt_table(
+                prows,
+                ["gen", "best lane", "best loss", "median loss",
+                 "exploits", "churn", "lr min/med/max"],
             )
         )
     return "\n".join(lines)
