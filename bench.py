@@ -1672,6 +1672,15 @@ def main():
         "artifacts/bench_coldstart_*.json)",
     )
     parser.add_argument(
+        "--service", action="store_true",
+        help="run the sweep-service acceptance drill (docs/SERVICE.md): "
+        "a real daemon killed with SIGKILL mid-sweep and restarted with "
+        "zero lost submissions, 2-tenant fair-share ratio within 10% of "
+        "weights, queue-wait/placement-latency books, and a "
+        "defragmentation event that demonstrably unblocks a starved "
+        "large-shape trial (banks artifacts/bench_service_*.json)",
+    )
+    parser.add_argument(
         "--suite", action="store_true",
         help="bank every measurement (flagship, fused-loss comparison, "
         "LM, to-elbo, loader) in one process — for one-shot windows on "
@@ -1683,12 +1692,13 @@ def main():
            for x in (args.concurrency, args.to_elbo, args.loader,
                      args.lm, args.suite, args.decode, args.stacked,
                      args.chaos, args.chaos_mh, args.coldstart,
-                     args.pbt)) > 1:
+                     args.pbt, args.service)) > 1:
         parser.error("--concurrency/--to-elbo/--loader/--lm/--decode/"
                      "--suite/--stacked/--chaos/--chaos-mh/--coldstart/"
-                     "--pbt are mutually exclusive")
+                     "--pbt/--service are mutually exclusive")
 
-    if (args.stacked or args.chaos or args.chaos_mh or args.pbt) and \
+    if (args.stacked or args.chaos or args.chaos_mh or args.pbt
+            or args.service) and \
             "xla_force_host_platform_device_count" not in (
         os.environ.get("XLA_FLAGS", "")
     ):
@@ -1985,6 +1995,85 @@ def main():
                     "fleet_summary": fleet["banked_paths"].get(
                         "summary", fleet["paths"].get("summary")
                     ),
+                    "detail": r,
+                }
+            )
+        )
+        return
+
+    if args.service:
+        import tempfile
+
+        from multidisttorch_tpu.service.drill import run_service_bench
+
+        r = run_service_bench(tempfile.mkdtemp(prefix="bench_service_"))
+        r["backend"] = backend
+        # Bank the scheduling artifact (ISSUE 10 acceptance):
+        # timestamped + _latest alias, same policy as --pbt/--coldstart.
+        banked = None
+        try:
+            os.makedirs("artifacts", exist_ok=True)
+            stamp = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
+            platform = backend.get("platform", "cpu")
+            banked = f"artifacts/bench_service_{platform}_{stamp}.json"
+            tmp = banked + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(r, f, indent=1)
+            os.replace(tmp, banked)
+            latest = "artifacts/bench_service_latest.json"
+            with open(latest + ".tmp", "w") as f:
+                json.dump({**r, "banked_as": banked}, f, indent=1)
+            os.replace(latest + ".tmp", latest)
+        except OSError as e:
+            print(f"artifact banking failed: {e!r}", file=sys.stderr)
+            banked = None
+        fair = r["kill_restart"]["fair_share"]
+        print(
+            json.dumps(
+                {
+                    "metric": "service_contended_fair_share_ratio",
+                    "value": fair["contended_ratio"],
+                    "unit": "tenant-A/tenant-B contended placements "
+                    "(weights 2:1)",
+                    # acceptance: ratio within 10% of the weights,
+                    # zero lost submissions across SIGKILL+restart,
+                    # and a defrag event unblocking a starved trial
+                    "vs_baseline": (
+                        round(
+                            fair["contended_ratio"]
+                            / fair["expected_ratio"],
+                            3,
+                        )
+                        if fair["contended_ratio"] is not None
+                        else None
+                    ),
+                    "zero_lost_submissions": r["gates"][
+                        "zero_lost_submissions"
+                    ],
+                    "tenant_goodput": r["kill_restart"]["tenant_goodput"],
+                    "defrag_unblocks_starved_trial": r["gates"][
+                        "defrag_unblocks_starved_trial"
+                    ],
+                    "queue_wait_p50_p99": [
+                        (r["kill_restart"].get("queue_wait") or {}).get(
+                            "p50_s"
+                        ),
+                        (r["kill_restart"].get("queue_wait") or {}).get(
+                            "p99_s"
+                        ),
+                    ],
+                    "placement_p50_p99": [
+                        (
+                            r["kill_restart"].get("placement_latency")
+                            or {}
+                        ).get("p50_s"),
+                        (
+                            r["kill_restart"].get("placement_latency")
+                            or {}
+                        ).get("p99_s"),
+                    ],
+                    "ok": r["ok"],
+                    "banked_as": banked,
                     "detail": r,
                 }
             )

@@ -17,11 +17,17 @@ unfinished one would not be).
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
 import time
 from typing import Optional
+
+try:  # POSIX file locking for the append/compact exclusion below
+    import fcntl
+except ImportError:  # non-POSIX host: degrade to unlocked (single-writer)
+    fcntl = None  # type: ignore[assignment]
 
 LEDGER_NAME = "sweep_ledger.jsonl"
 
@@ -75,18 +81,66 @@ class SweepLedger:
 
     # -- writing -----------------------------------------------------
 
+    @contextlib.contextmanager
+    def _mutate_lock(self):
+        """Exclusive advisory lock serializing every ledger MUTATION
+        (appends and the compaction rewrite) within and across
+        processes.
+
+        Compaction is load → rewrite-to-tmp → ``os.replace``; an append
+        racing that window lands on the snapshot file *after* the load
+        but is then clobbered by the replace — the appended record is
+        silently dropped (exactly the record a crash-recovery fold
+        would need). The sweep service makes this race routine: its
+        intake loop appends attempt records while the supervisor (or a
+        ``ledger_view --compact`` operator) compacts between worlds.
+        The lock lives on a sidecar (``.lock``) so the ledger file
+        itself can still be atomically replaced; readers stay lock-free
+        (the torn-tail-tolerant ``load`` never needed one)."""
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        if fcntl is None:
+            yield
+            return
+        fd = os.open(self.path + ".lock", os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            os.close(fd)  # closing drops the flock
+
     def append(self, event: dict) -> None:
         if not self.write:
             return
-        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         line = json.dumps({**event, "ts": time.time()}, default=str)
-        with open(self.path, "a") as f:
+        with self._mutate_lock(), open(self.path, "a") as f:
             f.write(line + "\n")
             f.flush()
             os.fsync(f.fileno())
 
+    @staticmethod
+    def _tag_fields(
+        tenant: Optional[str], priority: Optional[int],
+        submit_ts: Optional[float],
+    ) -> dict:
+        """Optional multi-tenant provenance (the sweep service's
+        scheduling books key off these). Absent tags serialize NOTHING
+        — pre-service ledgers and single-tenant sweeps stay
+        byte-identical, and old records parse unchanged."""
+        out: dict = {}
+        if tenant is not None:
+            out["tenant"] = str(tenant)
+        if priority is not None:
+            out["priority"] = int(priority)
+        if submit_ts is not None:
+            out["submit_ts"] = float(submit_ts)
+        return out
+
     def attempt_start(
-        self, trial_id: int, chash: str, attempt: int
+        self, trial_id: int, chash: str, attempt: int,
+        *,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+        submit_ts: Optional[float] = None,
     ) -> None:
         # Telemetry rides the ledger's call sites: every attempt
         # boundary in the driver (classic AND stacked-lane paths)
@@ -95,6 +149,7 @@ class SweepLedger:
         # observes attempts even when the ledger file itself is off.
         from multidisttorch_tpu.telemetry.events import get_bus
 
+        tags = self._tag_fields(tenant, priority, submit_ts)
         bus = get_bus()
         if bus is not None:
             bus.emit(
@@ -102,6 +157,7 @@ class SweepLedger:
                 trial_id=trial_id,
                 attempt=attempt,
                 config_hash=chash,
+                **tags,
             )
         self.append(
             {
@@ -109,6 +165,7 @@ class SweepLedger:
                 "trial_id": trial_id,
                 "config_hash": chash,
                 "attempt": attempt,
+                **tags,
             }
         )
 
@@ -121,6 +178,9 @@ class SweepLedger:
         *,
         error: str = "",
         summary: Optional[dict] = None,
+        tenant: Optional[str] = None,
+        priority: Optional[int] = None,
+        submit_ts: Optional[float] = None,
     ) -> None:
         """``status``: completed | diverged | retrying | failed |
         preempted. ``summary`` (completed/diverged) carries enough to
@@ -129,6 +189,7 @@ class SweepLedger:
         from multidisttorch_tpu.telemetry.events import get_bus
         from multidisttorch_tpu.telemetry.metrics import get_registry
 
+        tags = self._tag_fields(tenant, priority, submit_ts)
         bus = get_bus()
         if bus is not None:
             bus.emit(
@@ -139,6 +200,7 @@ class SweepLedger:
                 status=status,
                 error=error,
                 summary=summary or {},
+                **tags,
             )
         reg = get_registry()
         if reg is not None:
@@ -163,6 +225,7 @@ class SweepLedger:
                 "status": status,
                 "error": error,
                 "summary": summary or {},
+                **tags,
             }
         )
 
@@ -282,6 +345,15 @@ class SweepLedger:
         """
         if not self.write or not os.path.exists(self.path):
             return {"lines_before": 0, "lines_after": 0, "hashes": 0}
+        with self._mutate_lock():
+            return self._compact_locked()
+
+    def _compact_locked(self) -> dict:
+        # Under _mutate_lock: no append can land between the load below
+        # and the os.replace at the end, so the rewrite can never
+        # clobber a record it did not fold (the race this lock exists
+        # for — a live intake/attempt appender racing a between-worlds
+        # compaction used to drop the appended line).
         events = self.load()
         per_hash: dict[str, dict] = {}
         other: list[dict] = []  # hash-less events survive verbatim

@@ -1,0 +1,44 @@
+"""Sweep-as-a-service: a persistent multi-tenant trial scheduler.
+
+``run_hpo`` is a batch call over a fixed trial list; this package is
+the front door that turns the same machinery into a long-running
+service (docs/SERVICE.md):
+
+- :mod:`service.queue` — durable submission intake: tenants submit
+  :class:`~multidisttorch_tpu.hpo.driver.TrialConfig`-shaped work with
+  tenant/priority/deadline tags through :class:`SweepClient` (or
+  ``tools/sweep_submit.py``); every accepted submission survives a
+  daemon ``kill -9`` (the ledger's torn-tail JSONL semantics, extended
+  from crash LOG to intake QUEUE).
+- :mod:`service.scheduler` — admission control (per-tenant quotas,
+  backpressure verdicts), weighted fair-share with priority lanes
+  (deficit round-robin over tenants), and continuous shape-bucket
+  bin-packing of arriving trials onto free submeshes — same-shape
+  trials from *different* tenants co-pack into one vmapped dispatch
+  (PR 1's stacking).
+- :mod:`service.defrag` — online defragmentation: when a large-shape
+  trial starves behind a fragmented slice map, compact small running
+  trials onto fewer submeshes (checkpoint-drain + scan-back migration,
+  PR 5's machinery) to open a contiguous block.
+- :mod:`service.runtime` — the daemon loop (:class:`SweepService`)
+  driving all of it, exporting scheduling books (per-tenant goodput,
+  queue-wait and placement-latency histograms, fragmentation gauge)
+  through the telemetry bus; ``tools/sweep_service.py`` is the CLI.
+"""
+
+from multidisttorch_tpu.service.queue import (  # noqa: F401
+    Submission,
+    SubmissionQueue,
+    SweepClient,
+    fold_queue,
+)
+from multidisttorch_tpu.service.scheduler import (  # noqa: F401
+    FairShareScheduler,
+    PendingTrial,
+    SlicePool,
+    TenantPolicy,
+)
+from multidisttorch_tpu.service.defrag import (  # noqa: F401
+    DefragPlan,
+    plan_defrag,
+)

@@ -1,0 +1,171 @@
+"""Online submesh defragmentation: open a contiguous block by moving
+small running trials.
+
+The scheduler allocates CONTIGUOUS slice blocks (a submesh is a
+contiguous device span), so churn fragments the slice map: free
+capacity exists but no run of it is large enough for a big-shape
+trial, which then starves behind work that arrived later. MPMD-style
+placement (PAPERS.md, arxiv 2412.14374) presumes exactly this
+allocator problem; the fix is the classic one from memory compaction —
+move the small allocations together.
+
+This module is the pure PLANNER: given the free map, the live
+placements, and the starved trial's size, pick the cheapest window to
+clear. The runtime executes the plan with PR 5's migration machinery
+(checkpoint-drain the victim, free its slices, requeue it
+``resume_scan`` pinned to its relocation target — the trial restores
+from its last flushed checkpoint on the new submesh, bit-identically
+to a preemption restart).
+
+Planner contract (tests/test_service.py enforces all three):
+
+- every move's victim is MOVABLE — a placement whose checkpoint state
+  is flushed to disk (or that has no progress to lose). A trial with
+  an unflushed checkpoint is NEVER migrated: migration restores from
+  the last durable checkpoint, and moving a trial whose newest work
+  exists only in an in-flight write would silently discard it.
+- relocation targets lie wholly OUTSIDE the window being cleared and
+  fit in today's free runs — the plan is executable without a second
+  defrag.
+- among feasible windows the plan moves the least total slice-size
+  (ties: lowest window start) — defrag is paid on the critical path of
+  a starved trial, so the cheapest unblock wins.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from multidisttorch_tpu.service.scheduler import SlicePool
+
+
+@dataclass(frozen=True)
+class PlacedBlock:
+    """The planner's view of one live placement: where it sits and
+    whether it may be moved (the runtime answers ``movable`` from its
+    checkpoint bookkeeping — flushed-to-disk or nothing-to-lose)."""
+
+    placement_id: int
+    start: int
+    size: int
+    movable: bool
+
+
+@dataclass
+class DefragPlan:
+    """Moves to execute (in order) and the block they open.
+
+    ``moves`` are ``(placement_id, new_start)``; the window
+    ``[window_start, window_start + window_size)`` is the contiguous
+    block that becomes free once every victim's old slices are
+    released — the freed-slice accounting the ``defrag_end`` event
+    reports."""
+
+    window_start: int
+    window_size: int
+    moves: list[tuple[int, int]] = field(default_factory=list)
+
+
+def plan_defrag(
+    pool: SlicePool,
+    placements: list[PlacedBlock],
+    want_size: int,
+    *,
+    movable_fn: Optional[Callable[[PlacedBlock], bool]] = None,
+) -> Optional[DefragPlan]:
+    """Cheapest feasible plan opening ``want_size`` contiguous slices,
+    or ``None`` when no window can be cleared (every candidate window
+    holds an immovable trial, or the displaced trials cannot be
+    re-homed in the remaining free space).
+
+    ``movable_fn`` overrides/bolsters each block's own ``movable`` flag
+    (the runtime passes a live checkpoint-flushed check so the verdict
+    is taken at PLAN time, not placement time)."""
+    n = pool.n_slices
+    if want_size < 1 or want_size > n:
+        return None
+    if pool.largest_free_run() >= want_size:
+        # Nothing to do: a zero-move plan naming the already-free block.
+        for start, ln in pool.free_runs():
+            if ln >= want_size:
+                return DefragPlan(window_start=start, window_size=want_size)
+    by_slice: dict[int, PlacedBlock] = {}
+    for p in placements:
+        for i in range(p.start, p.start + p.size):
+            by_slice[i] = p
+    free = set(i for start, ln in pool.free_runs()
+               for i in range(start, start + ln))
+
+    def is_movable(p: PlacedBlock) -> bool:
+        if not p.movable:
+            return False
+        return movable_fn(p) if movable_fn is not None else True
+
+    best: Optional[tuple[int, int, DefragPlan]] = None  # (cost, start, plan)
+    for w0 in range(0, n - want_size + 1):
+        window = range(w0, w0 + want_size)
+        victims: dict[int, PlacedBlock] = {}
+        ok = True
+        for i in window:
+            if i in free:
+                continue
+            p = by_slice.get(i)
+            if p is None or not is_movable(p):
+                ok = False
+                break
+            # A victim straddling the window edge still moves whole.
+            victims[p.placement_id] = p
+        if not ok or not victims:
+            continue
+        # Re-home every victim in free runs OUTSIDE the window,
+        # first-fit over a working copy of the free map (victims'
+        # own old slices do NOT count — they free only after the
+        # move, and a plan must be executable move-by-move).
+        avail = sorted(i for i in free if i not in window)
+        runs = _runs_of(avail)
+        moves: list[tuple[int, int]] = []
+        feasible = True
+        for pid in sorted(victims):
+            p = victims[pid]
+            spot = _take_run(runs, p.size)
+            if spot is None:
+                feasible = False
+                break
+            moves.append((pid, spot))
+        if not feasible:
+            continue
+        cost = sum(victims[pid].size for pid, _ in moves)
+        key = (cost, w0)
+        if best is None or key < (best[0], best[1]):
+            best = (
+                cost,
+                w0,
+                DefragPlan(
+                    window_start=w0, window_size=want_size, moves=moves
+                ),
+            )
+    return best[2] if best is not None else None
+
+
+def _runs_of(slices: list[int]) -> list[list[int]]:
+    """Maximal ascending runs as mutable ``[start, length]`` cells."""
+    runs: list[list[int]] = []
+    for i in slices:
+        if runs and i == runs[-1][0] + runs[-1][1]:
+            runs[-1][1] += 1
+        else:
+            runs.append([i, 1])
+    return runs
+
+
+def _take_run(runs: list[list[int]], size: int) -> Optional[int]:
+    """First-fit claim of ``size`` contiguous slices from the working
+    free map; mutates ``runs``."""
+    for r in runs:
+        if r[1] >= size:
+            start = r[0]
+            r[0] += size
+            r[1] -= size
+            return start
+    return None

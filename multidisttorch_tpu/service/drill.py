@@ -1,0 +1,414 @@
+"""The sweep-service acceptance drill (``bench.py --service``).
+
+Two phases, one artifact (docs/SERVICE.md "Acceptance drill"):
+
+1. **Kill-and-restart + fair share** — a REAL daemon subprocess
+   (``tools/sweep_service.py``) serving 2 tenants x mixed shapes under
+   sustained contention, ``SIGKILL``ed mid-sweep (no drain — the crash
+   case), restarted, and run to completion. Gates: ZERO lost
+   submissions (every id settles), per-tenant goodput >= 0.8 across
+   the kill, and the contended fair-share ratio within 10% of the
+   configured 2:1 weights (measured from the durable journal — both
+   daemon incarnations included).
+2. **Defragmentation** — an in-process service over 4 slices driven
+   tick-by-tick into a fragmented layout (short trials leave
+   non-adjacent holes between long ones), then a size-2 trial starves
+   behind the fragmentation until the defrag policy migrates a small
+   running trial (checkpoint-drain + scan-back restore) and the
+   starved trial places in the opened window. Gates: a ``defrag_end``
+   event whose freed block demonstrably precedes the starved trial's
+   placement, and the migrated victim still settles ``completed``.
+
+Everything here is CPU-honest: virtual devices, synthetic data, tiny
+models — the protocol, not the FLOPs, is the subject.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+from typing import Optional
+
+from multidisttorch_tpu.service import queue as squeue
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _fair_share_from_journal(events: list[dict]) -> dict:
+    """Whole-run contended fair-share fold off the durable queue
+    journal (covers every daemon incarnation): a ``placed`` lane is
+    contended when, at that instant, at least two tenants had
+    submissions waiting (pending/admitted). Equal-cost drill configs
+    make lane counts the cost ratio."""
+    tenant_of: dict[str, str] = {}
+    waiting: dict[str, set] = {}  # tenant -> waiting sub_ids
+    placed: dict[str, int] = {}
+    contended: dict[str, int] = {}
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "submitted":
+            sub = ev.get("sub") or {}
+            sid, ten = sub.get("submission_id"), sub.get("tenant")
+            if sid:
+                tenant_of[sid] = ten
+                waiting.setdefault(ten, set()).add(sid)
+            continue
+        sid = ev.get("submission_id")
+        ten = tenant_of.get(sid)
+        if ten is None:
+            continue
+        if kind == "placed":
+            n_backlogged = sum(1 for s in waiting.values() if s)
+            placed[ten] = placed.get(ten, 0) + 1
+            if n_backlogged >= 2:
+                contended[ten] = contended.get(ten, 0) + 1
+            waiting.setdefault(ten, set()).discard(sid)
+        elif kind == "unplaced":
+            waiting.setdefault(ten, set()).add(sid)
+        elif kind in ("settled", "rejected"):
+            waiting.setdefault(ten, set()).discard(sid)
+    return {"placed": placed, "contended": contended}
+
+
+def _spawn_daemon(service_dir: str, *, weights: dict, log_path: str):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        )
+    env.pop("MDT_TELEMETRY", None)  # the daemon configures its own
+    argv = [
+        sys.executable,
+        os.path.join(REPO_ROOT, "tools", "sweep_service.py"),
+        service_dir,
+        "--slices", "2",
+        "--max-lanes", "2",
+        "--data-rows", "128",
+        "--retry", "2",
+        "--exit-when-drained",
+        "--idle-grace", "1.5",
+    ]
+    for name, w in sorted(weights.items()):
+        argv += ["--tenant-weight", f"{name}={w}"]
+    log_f = open(log_path, "a")
+    proc = subprocess.Popen(
+        argv, env=env, stdout=log_f, stderr=subprocess.STDOUT, text=True
+    )
+    return proc, log_f
+
+
+def _settled_count(service_dir: str) -> int:
+    folded = squeue.fold_queue(squeue.load_queue(service_dir))
+    return sum(
+        1 for r in folded.values() if r["state"] == squeue.SETTLED
+    )
+
+
+def run_kill_restart_phase(work_dir: str) -> dict:
+    """Phase 1: subprocess daemon, 2 tenants x mixed shapes, SIGKILL
+    mid-sweep, restart, all submissions settle."""
+    service_dir = os.path.join(work_dir, "service")
+    shutil.rmtree(service_dir, ignore_errors=True)
+    os.makedirs(service_dir, exist_ok=True)
+    log_path = os.path.join(work_dir, "daemon.log")
+    weights = {"alpha": 2.0, "beta": 1.0}
+
+    base = dict(batch_size=32, latent_dim=4, log_interval=1000, epochs=2)
+    shapes = (16, 24)  # two buckets — mixed shapes per tenant
+    subs: dict[str, list[str]] = {"alpha": [], "beta": []}
+    ca = squeue.SweepClient(service_dir, tenant="alpha")
+    cb = squeue.SweepClient(service_dir, tenant="beta")
+    for i in range(12):
+        subs["alpha"].append(
+            ca.submit({**base, "hidden_dim": shapes[i % 2], "seed": i})
+        )
+    for i in range(6):
+        subs["beta"].append(
+            cb.submit(
+                {**base, "hidden_dim": shapes[i % 2], "seed": 100 + i}
+            )
+        )
+    all_ids = subs["alpha"] + subs["beta"]
+
+    # Incarnation 1: run until mid-sweep, then SIGKILL (no drain).
+    proc, log_f = _spawn_daemon(
+        service_dir, weights=weights, log_path=log_path
+    )
+    kill_at = max(3, len(all_ids) // 4)
+    t0 = time.time()
+    killed_at_settled: Optional[int] = None
+    kill_exercised = False
+    try:
+        while time.time() - t0 < 300:
+            n = _settled_count(service_dir)
+            if n >= kill_at:
+                killed_at_settled = n
+                break
+            if proc.poll() is not None:
+                break  # finished before we could kill — gated below
+            time.sleep(0.25)
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGKILL)
+            kill_exercised = True
+        proc.wait(timeout=60)
+    finally:
+        log_f.close()
+    exit1 = proc.returncode
+    # The crash-durability gates are meaningless unless the crash
+    # actually happened: the daemon must have died BY our SIGKILL with
+    # work still outstanding, never by finishing early.
+    kill_exercised = kill_exercised and exit1 == -signal.SIGKILL
+
+    # Incarnation 2: restart over the same directory; everything
+    # recovers from the journal + ledger + checkpoints.
+    proc, log_f = _spawn_daemon(
+        service_dir, weights=weights, log_path=log_path
+    )
+    try:
+        final = squeue.SweepClient(service_dir).wait(
+            all_ids, timeout_s=600.0
+        )
+        proc.wait(timeout=120)  # idles out via --exit-when-drained
+    finally:
+        try:
+            if proc.poll() is None:
+                proc.terminate()
+                proc.wait(timeout=60)
+        except (OSError, subprocess.TimeoutExpired):
+            proc.kill()
+        log_f.close()
+    exit2 = proc.returncode
+
+    states = {s: r.get("state") for s, r in final.items()}
+    statuses = {s: r.get("status") for s, r in final.items()}
+    lost = sorted(
+        s
+        for s in all_ids
+        if states.get(s) not in (squeue.SETTLED, squeue.REJECTED)
+    )
+    completed = sum(1 for v in statuses.values() if v == "completed")
+
+    journal = squeue.load_queue(service_dir)
+    fair = _fair_share_from_journal(journal)
+    ca_n = fair["contended"].get("alpha", 0)
+    cb_n = fair["contended"].get("beta", 0)
+    ratio = (ca_n / cb_n) if cb_n else None
+    expected = weights["alpha"] / weights["beta"]
+    ratio_ok = (
+        ratio is not None and abs(ratio - expected) / expected <= 0.10
+    )
+
+    books = {}
+    try:
+        with open(os.path.join(service_dir, "service_books.json")) as f:
+            books = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+    tenants = books.get("tenants") or {}
+    goodputs = {
+        t: (tenants.get(t) or {}).get("goodput") for t in weights
+    }
+    goodput_ok = all(
+        g is not None and g >= 0.8 for g in goodputs.values()
+    )
+
+    return {
+        "submissions": len(all_ids),
+        "per_tenant_submitted": {t: len(v) for t, v in subs.items()},
+        "weights": weights,
+        "killed_at_settled": killed_at_settled,
+        "kill_exercised": kill_exercised,
+        "daemon_exits": [exit1, exit2],
+        "lost_submissions": lost,
+        "zero_lost": not lost,
+        "completed": completed,
+        "statuses": dict(sorted(statuses.items())),
+        "fair_share": {
+            **fair,
+            "contended_ratio": round(ratio, 3) if ratio else None,
+            "expected_ratio": expected,
+            "within_10pct": ratio_ok,
+        },
+        "tenant_goodput": goodputs,
+        "tenant_goodput_floor_0.8": goodput_ok,
+        "queue_wait": books.get("queue_wait"),
+        "placement_latency": books.get("placement_latency"),
+        "books_path": os.path.join(service_dir, "service_books.json"),
+        "daemon_log": log_path,
+    }
+
+
+def run_defrag_phase(work_dir: str) -> dict:
+    """Phase 2: in-process deterministic defrag drill (see module
+    docstring). Returns the event-level evidence."""
+    from multidisttorch_tpu import telemetry
+    from multidisttorch_tpu.service.runtime import SweepService
+
+    service_dir = os.path.join(work_dir, "defrag")
+    shutil.rmtree(service_dir, ignore_errors=True)
+    os.makedirs(service_dir, exist_ok=True)
+    tel_dir = os.path.join(service_dir, "telemetry")
+    own_telemetry = not telemetry.enabled()
+    if own_telemetry:
+        telemetry.configure(tel_dir)
+    # The defrag evidence is read from wherever events actually land:
+    # when the embedding process already configured telemetry, its
+    # sink — not our unconfigured tel_dir — holds the defrag_* events.
+    bus = telemetry.get_bus()
+    events_path = (
+        bus.path
+        if bus is not None and bus.path
+        else os.path.join(tel_dir, "events.jsonl")
+    )
+    client = squeue.SweepClient(service_dir, tenant="drill")
+    base = dict(batch_size=32, latent_dim=4, log_interval=1000)
+    svc = SweepService(
+        service_dir,
+        n_slices=4,
+        max_lanes=1,
+        data_rows=128,
+        starvation_s=0.4,
+        defrag_cooldown_s=0.1,
+        verbose=False,
+    )
+    report: dict = {"ok": False}
+    try:
+        # Sequential submits, ticking between each, pin the layout:
+        # short@0, long@1, short@2, long@3 (four distinct shape
+        # buckets so nothing co-packs).
+        layout = [
+            {**base, "epochs": 1, "hidden_dim": 16},
+            {**base, "epochs": 40, "hidden_dim": 24},
+            {**base, "epochs": 1, "hidden_dim": 40},
+            {**base, "epochs": 40, "hidden_dim": 56},
+        ]
+        for cfg in layout:
+            client.submit(cfg)
+            t0 = time.time()
+            while time.time() - t0 < 30:
+                svc.tick()
+                if svc.sched.pending_count() == 0:
+                    break
+        # Let the short trials finish: their freed slices are the
+        # non-adjacent holes.
+        t0 = time.time()
+        while time.time() - t0 < 120:
+            svc.tick()
+            if (
+                sum(
+                    1
+                    for s in svc.settled.values()
+                    if s == "completed"
+                )
+                >= 2
+            ):
+                break
+        frag_runs = svc.pool.free_runs()
+        big = client.submit(
+            {**base, "epochs": 1, "hidden_dim": 16, "seed": 9}, size=2
+        )
+        t_submit = time.time()
+        while time.time() - t_submit < 180:
+            svc.tick()
+            if svc.settled.get(big):
+                break
+        unblock_wait_s = round(time.time() - t_submit, 3)
+        big_status = svc.settled.get(big)
+        # Run the migrated long trials to completion so the drill also
+        # proves the scan-back restore produced a finishable trial.
+        t0 = time.time()
+        while len(svc.settled) < 5 and time.time() - t0 < 300:
+            svc.tick()
+        svc._drain(reason="drill end")
+        books = svc.books()
+    finally:
+        events = telemetry.read_events(events_path)
+        if own_telemetry:
+            telemetry.disable()
+    def_events = [
+        e for e in events if str(e.get("kind", "")).startswith("defrag")
+    ]
+    ends = [e for e in def_events if e["kind"] == "defrag_end"]
+    placed_big = [
+        e
+        for e in events
+        if e.get("kind") == "trial_placed"
+        and (e.get("data") or {}).get("sub_id") == big
+    ]
+    unblocked_after_defrag = bool(
+        ends
+        and placed_big
+        and placed_big[-1]["ts"] >= ends[0]["ts"]
+    )
+    migrated = [
+        e for e in events if e.get("kind") == "trial_migrated"
+    ]
+    report.update(
+        {
+            "fragmented_free_runs": frag_runs,
+            "big_submission": big,
+            "big_status": big_status,
+            "unblock_wait_s": unblock_wait_s,
+            "defrag_events": {
+                k: sum(1 for e in def_events if e["kind"] == k)
+                for k in (
+                    "defrag_start", "defrag_move", "defrag_end",
+                    "defrag_blocked",
+                )
+            },
+            "defrag_end": (ends[0].get("data") if ends else None),
+            "migrations": [
+                {**(e.get("data") or {}), "trial_id": e.get("trial_id")}
+                for e in migrated
+            ],
+            "all_settled": sorted(svc.settled.values()),
+            "all_completed": all(
+                s == "completed" for s in svc.settled.values()
+            ),
+            "unblocked_after_defrag": unblocked_after_defrag,
+            "fragmentation_books": books.get("fragmentation"),
+            "defrag_books": books.get("defrag"),
+            "ok": bool(
+                ends
+                and big_status == "completed"
+                and unblocked_after_defrag
+                and migrated
+            ),
+        }
+    )
+    return report
+
+
+def run_service_bench(work_dir: str) -> dict:
+    os.makedirs(work_dir, exist_ok=True)
+    t0 = time.time()
+    phase1 = run_kill_restart_phase(work_dir)
+    phase2 = run_defrag_phase(work_dir)
+    gates = {
+        "kill_exercised": phase1["kill_exercised"],
+        "zero_lost_submissions": phase1["zero_lost"],
+        "fair_share_within_10pct": phase1["fair_share"]["within_10pct"],
+        "tenant_goodput_floor": phase1["tenant_goodput_floor_0.8"],
+        "latency_books_present": bool(
+            (phase1.get("queue_wait") or {}).get("count")
+            and (phase1.get("placement_latency") or {}).get("count")
+        ),
+        "defrag_unblocks_starved_trial": phase2["ok"],
+    }
+    return {
+        "protocol": "service_v1",
+        "wall_s": round(time.time() - t0, 1),
+        "kill_restart": phase1,
+        "defrag": phase2,
+        "gates": gates,
+        "ok": all(gates.values()),
+    }

@@ -1,0 +1,499 @@
+"""Durable submission intake: the sweep service's crash-safe queue.
+
+The ledger (``hpo/ledger.py``) is a crash LOG — this module extends the
+same JSONL machinery into an intake QUEUE with a two-stage durability
+protocol, so that *every accepted submission survives a daemon restart*
+(including ``kill -9`` mid-append — the acceptance drill in
+``bench.py --service``):
+
+1. **Client spool** (:class:`SweepClient`): each ``submit()`` lands one
+   submission as its own file under ``{service_dir}/intake/``, written
+   atomically (tmp + fsync + rename, the checkpoint layer's pattern).
+   Many tenants submit concurrently with no shared-file coordination —
+   rename is the commit point. A client killed mid-write leaves only a
+   ``.tmp`` the daemon ignores.
+2. **Daemon journal** (:class:`SubmissionQueue`): the single-writer
+   daemon drains the spool into ``{service_dir}/queue.jsonl`` — one
+   fsync'd JSON record per state transition (``submitted`` →
+   ``admitted``/``rejected`` → ``placed`` → ``settled``, plus
+   ``unplaced`` when a drain/defrag takes a trial off its submesh).
+   The spool file is unlinked only AFTER its ``submitted`` record is
+   durable, so a crash between the two replays the file and the
+   journal's ``submission_id`` dedup makes the replay idempotent.
+
+Crash model (the ledger's): an append either lands whole or tears the
+final line; :func:`fold_queue` skips undecodable lines, so a torn tail
+costs at most the last *transition* — never the submission itself (its
+``submitted`` record, or failing that its spool file, is still there).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+QUEUE_NAME = "queue.jsonl"
+INTAKE_DIR = "intake"
+
+# Submission lifecycle states, in order. ``rejected`` is terminal like
+# ``settled``; ``unplaced`` folds back to ``admitted`` (the trial is
+# queued again — a drain or a defrag migration took it off its submesh).
+PENDING = "pending"        # submitted, not yet through admission
+ADMITTED = "admitted"      # passed admission; waiting for a submesh
+PLACED = "placed"          # running on a submesh
+SETTLED = "settled"        # terminal trial outcome recorded
+REJECTED = "rejected"      # admission verdict said no
+
+
+@dataclass(frozen=True)
+class Submission:
+    """One tenant's ask: a trial config plus scheduling identity.
+
+    ``config`` is the :class:`~multidisttorch_tpu.hpo.driver.
+    TrialConfig` field dict *without* ``trial_id`` (the service assigns
+    trial ids at admission). ``size`` is the submesh footprint in
+    slices (1 = smallest schedulable submesh; >1 asks for that many
+    CONTIGUOUS slices — the large-shape case defrag exists for).
+    ``priority`` is a lane: 0 is served strictly before 1, which is
+    served strictly before 2 (fair-share applies *within* a lane).
+    ``deadline_s`` is advisory metadata surfaced in the books (the
+    scheduler does not kill overdue trials)."""
+
+    submission_id: str
+    tenant: str
+    config: dict
+    priority: int = 1
+    size: int = 1
+    deadline_s: Optional[float] = None
+    submit_ts: float = 0.0
+
+    def to_dict(self) -> dict:
+        d = {
+            "submission_id": self.submission_id,
+            "tenant": self.tenant,
+            "config": dict(self.config),
+            "priority": int(self.priority),
+            "size": int(self.size),
+            "submit_ts": float(self.submit_ts),
+        }
+        if self.deadline_s is not None:
+            d["deadline_s"] = float(self.deadline_s)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Submission":
+        return cls(
+            submission_id=str(d["submission_id"]),
+            tenant=str(d.get("tenant", "default")),
+            config=dict(d.get("config") or {}),
+            priority=int(d.get("priority", 1)),
+            size=int(d.get("size", 1)),
+            deadline_s=(
+                float(d["deadline_s"])
+                if d.get("deadline_s") is not None
+                else None
+            ),
+            submit_ts=float(d.get("submit_ts", 0.0)),
+        )
+
+
+def intake_dir(service_dir: str) -> str:
+    return os.path.join(service_dir, INTAKE_DIR)
+
+
+def queue_path(service_dir: str) -> str:
+    return os.path.join(service_dir, QUEUE_NAME)
+
+
+class SweepClient:
+    """Tenant-side submission API (file transport).
+
+    The transport is the shared filesystem the checkpoint/ledger layers
+    already require, so a client needs no daemon connection: ``submit``
+    is durable the moment it returns (the rename landed), and the
+    daemon picks it up on its next intake scan. ``status``/``wait``
+    read the daemon's journal fold — the same fold the daemon itself
+    recovers from, so client and daemon can never disagree about a
+    submission's state."""
+
+    def __init__(self, service_dir: str, *, tenant: str = "default"):
+        self.service_dir = service_dir
+        self.tenant = tenant
+
+    def submit(
+        self,
+        config: dict,
+        *,
+        priority: int = 1,
+        size: int = 1,
+        deadline_s: Optional[float] = None,
+        tenant: Optional[str] = None,
+    ) -> str:
+        """Durably submit one trial; returns the submission id."""
+        if priority < 0:
+            raise ValueError(f"priority must be >= 0, got {priority}")
+        if size < 1:
+            raise ValueError(f"size must be >= 1, got {size}")
+        ten = self.tenant if tenant is None else tenant
+        sub = Submission(
+            submission_id=f"{ten}-{uuid.uuid4().hex[:12]}",
+            tenant=ten,
+            config=dict(config),
+            priority=priority,
+            size=size,
+            deadline_s=deadline_s,
+            submit_ts=time.time(),
+        )
+        d = intake_dir(self.service_dir)
+        os.makedirs(d, exist_ok=True)
+        final = os.path.join(d, sub.submission_id + ".json")
+        tmp = final + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(sub.to_dict(), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)  # the commit point
+        try:  # best-effort dir fsync, like train/checkpoint.py
+            fd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass
+        return sub.submission_id
+
+    def status(self, submission_id: str) -> Optional[dict]:
+        """This submission's folded state, or None if unknown. A spool
+        file the daemon has not drained yet reports ``pending``.
+
+        Order matters: the spool is checked BEFORE the journal is
+        folded. The daemon unlinks a spool file only after its
+        ``submitted`` record is durable, so a spool miss followed by a
+        journal read cannot miss both — checking the journal first
+        leaves a window where a mid-drain submission (append landed
+        after our fold, unlink before our spool check) reads as
+        unknown despite being durably committed."""
+        p = os.path.join(
+            intake_dir(self.service_dir), submission_id + ".json"
+        )
+        spooled = os.path.exists(p)
+        rec = fold_queue(load_queue(self.service_dir)).get(submission_id)
+        if rec is not None:
+            return rec
+        if spooled:
+            return {"state": PENDING, "submission_id": submission_id}
+        return None
+
+    def wait(
+        self,
+        submission_ids,
+        *,
+        timeout_s: float = 300.0,
+        poll_s: float = 0.25,
+    ) -> dict[str, dict]:
+        """Block until every submission reaches a terminal state
+        (settled/rejected) or the deadline passes; returns the final
+        fold per id (missing ids map to None-state dicts)."""
+        ids = list(submission_ids)
+        deadline = time.time() + timeout_s
+        while True:
+            folded = fold_queue(load_queue(self.service_dir))
+            out = {
+                s: folded.get(s, {"state": PENDING, "submission_id": s})
+                for s in ids
+            }
+            if all(
+                r["state"] in (SETTLED, REJECTED) for r in out.values()
+            ):
+                return out
+            if time.time() > deadline:
+                return out
+            time.sleep(poll_s)
+
+
+class SubmissionQueue:
+    """Daemon-side durable journal (single writer — the daemon).
+
+    Appends are fsync'd whole-line JSONL with the ledger's torn-tail
+    read contract. The journal is append-only across daemon restarts
+    (unlike the telemetry sink's truncate-per-run): the queue IS the
+    service's control state, and a restarted daemon re-folds it to
+    recover exactly where the previous incarnation died."""
+
+    def __init__(self, service_dir: str, *, write: bool = True):
+        self.service_dir = service_dir
+        self.path = queue_path(service_dir)
+        self.write = write
+
+    # -- journal ------------------------------------------------------
+
+    def append(self, record: dict) -> None:
+        if not self.write:
+            return
+        os.makedirs(self.service_dir, exist_ok=True)
+        line = json.dumps({**record, "ts": time.time()}, default=str)
+        with open(self.path, "a") as f:
+            f.write(line + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def load(self) -> list[dict]:
+        return load_queue(self.service_dir)
+
+    # -- intake drain -------------------------------------------------
+
+    def drain_intake(self, *, known_ids: set) -> list[Submission]:
+        """Journal every new spool file as ``submitted`` and unlink it.
+
+        ``known_ids`` is the fold's id set — a spool file whose id is
+        already journaled (crash landed between append and unlink) is
+        unlinked without a duplicate record. Torn ``.tmp`` files and
+        undecodable spool files are skipped (a client died mid-write;
+        its submission never committed). Returns the newly accepted
+        submissions in spool-name order (deterministic across
+        restarts)."""
+        d = intake_dir(self.service_dir)
+        if not os.path.isdir(d):
+            return []
+        fresh: list[Submission] = []
+        for name in sorted(os.listdir(d)):
+            if not name.endswith(".json"):
+                continue  # .tmp = a client mid-write (or dead mid-write)
+            p = os.path.join(d, name)
+            try:
+                with open(p) as f:
+                    sub = Submission.from_dict(json.load(f))
+            except (OSError, json.JSONDecodeError, KeyError, ValueError):
+                continue  # torn/garbled spool file: never committed
+            if sub.submission_id not in known_ids:
+                self.append({"event": "submitted", "sub": sub.to_dict()})
+                known_ids.add(sub.submission_id)
+                fresh.append(sub)
+            try:
+                os.unlink(p)  # AFTER the durable append — replay-safe
+            except OSError:
+                pass
+        return fresh
+
+    # -- state transitions -------------------------------------------
+
+    def admitted(
+        self, sub_id: str, *, trial_id: int, chash: str, bucket: str
+    ) -> None:
+        self.append(
+            {
+                "event": "admitted",
+                "submission_id": sub_id,
+                "trial_id": trial_id,
+                "config_hash": chash,
+                "bucket": bucket,
+            }
+        )
+
+    def rejected(self, sub_id: str, *, verdict: str, reason: str) -> None:
+        self.append(
+            {
+                "event": "rejected",
+                "submission_id": sub_id,
+                "verdict": verdict,
+                "reason": reason,
+            }
+        )
+
+    def placed(
+        self,
+        sub_id: str,
+        *,
+        trial_id: int,
+        start: int,
+        size: int,
+        lanes: int,
+        stacked: bool,
+        resumed: bool,
+    ) -> None:
+        self.append(
+            {
+                "event": "placed",
+                "submission_id": sub_id,
+                "trial_id": trial_id,
+                "start": start,
+                "size": size,
+                "lanes": lanes,
+                "stacked": stacked,
+                "resumed": resumed,
+            }
+        )
+
+    def unplaced(self, sub_id: str, *, trial_id: int, reason: str) -> None:
+        """The trial came off its submesh WITHOUT settling (graceful
+        drain, defrag migration, infra retry): it is queued again."""
+        self.append(
+            {
+                "event": "unplaced",
+                "submission_id": sub_id,
+                "trial_id": trial_id,
+                "reason": reason,
+            }
+        )
+
+    def settled(
+        self, sub_id: str, *, trial_id: int, status: str, error: str = ""
+    ) -> None:
+        self.append(
+            {
+                "event": "settled",
+                "submission_id": sub_id,
+                "trial_id": trial_id,
+                "status": status,
+                "error": error,
+            }
+        )
+
+
+def load_queue(service_dir: str) -> list[dict]:
+    """All decodable journal records, append order, torn tail skipped
+    (the ledger's read contract — importable without jax)."""
+    path = queue_path(service_dir)
+    events: list[dict] = []
+    try:
+        f = open(path)
+    except OSError:
+        return events
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(ev, dict):
+                events.append(ev)
+    return events
+
+
+def read_jsonl_from(path: str, offset: int) -> tuple[list[dict], int]:
+    """Decodable records from COMPLETE lines past byte ``offset``;
+    returns ``(records, new_offset)``. A final line with no newline yet
+    (a writer mid-append) is left for the next call — the incremental
+    sibling of :func:`load_queue`, shared by the daemon's books fold so
+    a long-lived service never re-reads its whole history per tick."""
+    try:
+        f = open(path, "rb")
+    except OSError:
+        return [], offset
+    with f:
+        f.seek(offset)
+        buf = f.read()
+    end = buf.rfind(b"\n")
+    if end < 0:
+        return [], offset
+    records: list[dict] = []
+    for raw in buf[:end].split(b"\n"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            ev = json.loads(raw)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            continue
+        if isinstance(ev, dict):
+            records.append(ev)
+    return records, offset + end + 1
+
+
+def fold_queue(events: list[dict]) -> dict[str, dict]:
+    """submission_id -> folded lifecycle state.
+
+    The ONE state-machine fold: the daemon's restart recovery, the
+    client's ``status``/``wait``, ``tools/ledger_view.py --queue`` and
+    the service books all read this, so none of them can disagree. Each
+    value carries the submission's identity (tenant/priority/size/
+    submit_ts/config), its current ``state``, the assigned
+    ``trial_id``/``bucket`` once admitted, per-transition timestamps,
+    and the terminal ``status`` once settled."""
+    return fold_queue_into({}, events)
+
+
+def fold_queue_into(
+    out: dict[str, dict], events: list[dict]
+) -> dict[str, dict]:
+    """Incremental form of :func:`fold_queue`: fold ``events`` into an
+    existing state (the daemon feeds newly-appended journal records
+    through a persistent fold instead of re-folding history)."""
+    for ev in events:
+        kind = ev.get("event")
+        if kind == "submitted":
+            sub = ev.get("sub") or {}
+            sid = sub.get("submission_id")
+            if not sid:
+                continue
+            out[sid] = {
+                "submission_id": sid,
+                "state": PENDING,
+                "tenant": sub.get("tenant", "default"),
+                "priority": int(sub.get("priority", 1)),
+                "size": int(sub.get("size", 1)),
+                "submit_ts": float(sub.get("submit_ts", 0.0)),
+                "deadline_s": sub.get("deadline_s"),
+                "config": sub.get("config") or {},
+                "trial_id": None,
+                "bucket": None,
+                "status": None,
+                "error": "",
+                "ts": {"submitted": ev.get("ts")},
+                "placements": 0,
+            }
+            continue
+        sid = ev.get("submission_id")
+        rec = out.get(sid)
+        if rec is None:
+            continue  # transition for a submission whose intro tore
+        rec["ts"][str(kind)] = ev.get("ts")
+        if kind == "admitted":
+            rec["state"] = ADMITTED
+            rec["trial_id"] = ev.get("trial_id")
+            rec["bucket"] = ev.get("bucket")
+            rec["config_hash"] = ev.get("config_hash")
+        elif kind == "rejected":
+            rec["state"] = REJECTED
+            rec["status"] = ev.get("verdict", "rejected")
+            rec["error"] = ev.get("reason", "")
+        elif kind == "placed":
+            rec["state"] = PLACED
+            rec["placements"] = rec.get("placements", 0) + 1
+            rec["last_placement"] = {
+                k: ev.get(k)
+                for k in ("start", "size", "lanes", "stacked", "resumed")
+            }
+        elif kind == "unplaced":
+            rec["state"] = ADMITTED
+            rec["unplaced_reason"] = ev.get("reason", "")
+        elif kind == "settled":
+            rec["state"] = SETTLED
+            rec["status"] = ev.get("status", "?")
+            rec["error"] = ev.get("error", "") or ""
+    return out
+
+
+@dataclass
+class QueueStats:
+    """Counts-by-state rollup of a fold (the console header)."""
+
+    by_state: dict = field(default_factory=dict)
+    by_tenant: dict = field(default_factory=dict)
+
+    @classmethod
+    def of(cls, folded: dict[str, dict]) -> "QueueStats":
+        s = cls()
+        for rec in folded.values():
+            s.by_state[rec["state"]] = s.by_state.get(rec["state"], 0) + 1
+            t = s.by_tenant.setdefault(rec["tenant"], {})
+            t[rec["state"]] = t.get(rec["state"], 0) + 1
+        return s

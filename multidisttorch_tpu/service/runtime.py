@@ -1,0 +1,1314 @@
+"""The sweep service daemon: a persistent scheduler over live submeshes.
+
+:class:`SweepService` is the loop that turns ``run_hpo``'s batch
+machinery into a service (docs/SERVICE.md):
+
+- **intake**: drain the durable submission spool
+  (:mod:`service.queue`), run admission (quota/backpressure verdicts),
+  assign trial ids and config hashes, and — when the compile farm is
+  on — warm each admitted trial's executables BEFORE placement
+  (PR 7's :class:`~multidisttorch_tpu.compile.farm.PrecompilePool`).
+- **scheduling**: one DRR pass per tick
+  (:class:`~multidisttorch_tpu.service.scheduler.FairShareScheduler`);
+  each placement becomes a live ``_TrialRun`` (or, for co-packed
+  same-shape trials — tenants mixed — a ``_StackedBucketRun``) on a
+  submesh carved on the fly from the placement's slice block.
+- **stepping**: the driver's cooperative-generator discipline — one
+  async dispatch per placement per tick, no cross-placement barrier
+  anywhere; completion/divergence/infra-retry handling mirrors
+  ``_run_hpo_body``'s supervision, with the ledger carrying
+  tenant/priority/submit_ts provenance on every attempt record.
+- **defragmentation**: a large-shape trial starved past
+  ``starvation_s`` behind a fragmented slice map triggers
+  :func:`~multidisttorch_tpu.service.defrag.plan_defrag`; victims are
+  checkpoint-drained and migrated (PR 5's scan-back restore) to open a
+  contiguous block, under typed ``defrag_*`` events.
+- **durability**: every state transition is journaled
+  (``queue.jsonl``) and every attempt is ledgered BEFORE the matching
+  in-memory transition, so a ``kill -9`` at any instant loses no
+  submission: the restarted daemon re-folds both files and resumes
+  (placed-but-unsettled trials re-place with scan-back restore).
+- **books**: per-tenant goodput (off the tenant-tagged ledger),
+  queue-wait and placement-latency histograms, the fragmentation
+  gauge, and defrag accounting — written atomically to
+  ``service_books.json`` and mirrored as telemetry events for
+  ``tools/sweep_top.py --service``.
+
+SIGTERM drain (the CLI installs the handler): in-flight checkpoint
+writes land, live attempts are recorded ``preempted``/``unplaced``,
+books are written, and ``serve`` returns a drained report — under
+``tools/sweep_supervisor.py`` the daemon then exits with the
+preemption code and is relaunched into the next world.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Optional
+
+from multidisttorch_tpu.hpo.ledger import SweepLedger, config_hash
+from multidisttorch_tpu.hpo.supervision import (
+    DIVERGENCE,
+    FATAL,
+    INFRA,
+    PREEMPTION,
+    RetryPolicy,
+    SETTLED_STATUSES,
+    classify_failure,
+)
+from multidisttorch_tpu.service import queue as squeue
+from multidisttorch_tpu.service.defrag import PlacedBlock, plan_defrag
+from multidisttorch_tpu.service.scheduler import (
+    ADMIT,
+    FairShareScheduler,
+    PendingTrial,
+    Placement,
+    REJECT_INVALID,
+    SlicePool,
+    TenantPolicy,
+)
+from multidisttorch_tpu.utils.logging import log0
+
+BOOKS_NAME = "service_books.json"
+
+# Histogram bucket edges for the scheduling-latency books (seconds).
+# Finer than the step-time defaults at the low end: queue waits and
+# placement latencies of interest run 10 ms .. minutes.
+LATENCY_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+    60.0, 120.0, 300.0, 600.0,
+)
+
+
+def _emit(kind: str, **data) -> None:
+    from multidisttorch_tpu.telemetry.events import get_bus
+
+    bus = get_bus()
+    if bus is not None:
+        bus.emit(kind, **data)
+
+
+class TaggedLedger(SweepLedger):
+    """A :class:`SweepLedger` that stamps tenant provenance on every
+    attempt record from a trial-id → tags map, so the driver-owned
+    call sites (``_StackedBucketRun`` ledgers its own lanes) carry the
+    service's multi-tenant identity without knowing about tenants."""
+
+    def __init__(self, out_dir: str, **kw):
+        super().__init__(out_dir, **kw)
+        self.tags: dict[int, dict] = {}
+
+    def tag(self, trial_id: int, *, tenant, priority, submit_ts) -> None:
+        self.tags[trial_id] = {
+            "tenant": tenant,
+            "priority": priority,
+            "submit_ts": submit_ts,
+        }
+
+    def attempt_start(self, trial_id, chash, attempt, **kw):
+        t = self.tags.get(trial_id, {})
+        for k, v in t.items():
+            kw.setdefault(k, v)
+        super().attempt_start(trial_id, chash, attempt, **kw)
+
+    def attempt_end(self, trial_id, chash, attempt, status, **kw):
+        t = self.tags.get(trial_id, {})
+        for k, v in t.items():
+            kw.setdefault(k, v)
+        super().attempt_end(trial_id, chash, attempt, status, **kw)
+
+
+def fold_tenant_goodput(records: list[dict]) -> dict[str, dict]:
+    """Per-tenant goodput off tenant-tagged LEDGER records — the
+    durable accounting that survives daemon kills (the telemetry fold
+    in ``telemetry/export.py`` keeps the live mirror). Same math as
+    ``SweepFold``: ``executed`` covers every attempt's own work plus
+    any killed-attempt prefix visible only as a later resume point;
+    ``useful`` counts settled attempts' cumulative steps."""
+    books: dict[str, dict] = {}
+    fold_tenant_goodput_into(books, {}, records)
+    return finalize_tenant_goodput(books)
+
+
+def fold_tenant_goodput_into(
+    books: dict[str, dict], covered: dict[int, int], records: list[dict]
+) -> None:
+    """Incremental form of :func:`fold_tenant_goodput`: accumulate new
+    ledger records into persistent state (``covered`` is the per-trial
+    step-coverage map the killed-attempt accounting needs)."""
+    for ev in records:
+        if ev.get("event") != "attempt_end":
+            continue
+        tenant = ev.get("tenant")
+        if tenant is None:
+            continue
+        b = books.setdefault(
+            tenant,
+            {
+                "attempts": 0,
+                "settled": 0,
+                "useful_steps": 0,
+                "executed_steps": 0,
+                "statuses": {},
+            },
+        )
+        b["attempts"] += 1
+        status = ev.get("status", "?")
+        b["statuses"][status] = b["statuses"].get(status, 0) + 1
+        s = ev.get("summary") or {}
+        done = int(s.get("steps", s.get("steps_at_failure", 0)) or 0)
+        resumed = int(s.get("resumed_from_step", 0) or 0)
+        tid = int(ev.get("trial_id", -1))
+        cov = covered.get(tid, 0)
+        b["executed_steps"] += max(0, done - resumed) + max(0, resumed - cov)
+        covered[tid] = max(cov, done)
+        if status in SETTLED_STATUSES:
+            b["settled"] += 1
+            b["useful_steps"] += done
+
+
+def finalize_tenant_goodput(books: dict[str, dict]) -> dict[str, dict]:
+    """Derive goodput into a fresh snapshot (the persistent fold state
+    stays counters-only, so repeated finalization never double-writes)."""
+    out = {}
+    for tenant, b in books.items():
+        out[tenant] = {
+            **{k: (dict(v) if isinstance(v, dict) else v)
+               for k, v in b.items()},
+            "goodput": (
+                round(b["useful_steps"] / b["executed_steps"], 4)
+                if b["executed_steps"]
+                else None
+            ),
+        }
+    return out
+
+
+@dataclass
+class _Active:
+    """One live placement: the run object, its generator, and the
+    member bookkeeping the settle/retry/defrag paths need."""
+
+    placement_id: int
+    start: int
+    size: int
+    stacked: bool
+    run: object
+    gen: object
+    entries: dict  # trial_id -> PendingTrial
+    place_ts: float
+    construct_s: float
+    first_step_done: bool = False
+    tenants: tuple = ()
+
+    def movable(self) -> bool:
+        """Defrag victim eligibility, decided at PLAN time: single
+        runs only (stacked lanes checkpoint at retirement, so a moved
+        bucket would lose every live lane's progress), and never with
+        an UNFLUSHED checkpoint — a write still in flight (or progress
+        beyond the last landed checkpoint... which migration would
+        roll back to) must finish before the trial may move. Precisely:
+        movable iff no checkpoint write is in flight AND (a durable
+        checkpoint exists OR the trial has made no optimizer step —
+        nothing to lose)."""
+        if self.stacked:
+            return False
+        run = self.run
+        t = getattr(run, "_ckpt_thread", None)
+        if t is not None and t.is_alive():
+            return False  # unflushed checkpoint write in flight
+        has_ckpt = bool(run.result.checkpoint)
+        return has_ckpt or int(getattr(run, "_step_no", 0)) == 0
+
+
+class SweepService:
+    """The persistent multi-tenant sweep daemon (see module docstring).
+
+    Construct once per daemon process and call :meth:`serve`. All
+    durable state lives under ``service_dir`` (queue journal, sweep
+    ledger, per-trial checkpoints, telemetry, books): a new
+    ``SweepService`` over the same directory resumes the previous
+    incarnation's world exactly.
+    """
+
+    def __init__(
+        self,
+        service_dir: str,
+        *,
+        n_slices: Optional[int] = None,
+        devices=None,
+        max_lanes: int = 4,
+        policies: Optional[dict[str, TenantPolicy]] = None,
+        default_policy: Optional[TenantPolicy] = None,
+        max_total_pending: int = 4096,
+        train_data=None,
+        test_data=None,
+        data_rows: int = 512,
+        starvation_s: float = 3.0,
+        defrag_enabled: bool = True,
+        defrag_cooldown_s: float = 1.0,
+        retry: Optional[RetryPolicy] = None,
+        save_checkpoints: bool = True,
+        ckpt_keep_last: int = 2,
+        verbose: bool = False,
+        precompile: bool = False,
+        idle_sleep_s: float = 0.02,
+        books_every_s: float = 1.0,
+    ):
+        import jax
+
+        from multidisttorch_tpu.data.datasets import synthetic_mnist
+
+        self.service_dir = service_dir
+        os.makedirs(service_dir, exist_ok=True)
+        devs = list(jax.devices()) if devices is None else list(devices)
+        self.n_slices = len(devs) if n_slices is None else int(n_slices)
+        if self.n_slices < 1 or len(devs) % self.n_slices:
+            raise ValueError(
+                f"{len(devs)} devices do not divide into "
+                f"{self.n_slices} slices"
+            )
+        self._devices = devs
+        self._devs_per_slice = len(devs) // self.n_slices
+        self.max_lanes = int(max_lanes)
+        self.pool = SlicePool(self.n_slices)
+        self.sched = FairShareScheduler(
+            policies,
+            default_policy=default_policy,
+            max_total_pending=max_total_pending,
+        )
+        self.queue = squeue.SubmissionQueue(service_dir)
+        self.ledger = TaggedLedger(service_dir)
+        self.train_data = (
+            train_data
+            if train_data is not None
+            else synthetic_mnist(data_rows, seed=0)
+        )
+        self.test_data = test_data
+        self.starvation_s = float(starvation_s)
+        self.defrag_enabled = bool(defrag_enabled)
+        self.defrag_cooldown_s = float(defrag_cooldown_s)
+        self.retry = retry
+        self.save_checkpoints = bool(save_checkpoints)
+        self.ckpt_keep_last = int(ckpt_keep_last)
+        self.verbose = bool(verbose)
+        self.precompile = bool(precompile)
+        self.idle_sleep_s = float(idle_sleep_s)
+        self.books_every_s = float(books_every_s)
+
+        # Mutable service state.
+        self.active: dict[int, _Active] = {}
+        self.attempts: dict[int, int] = {}
+        self.chashes: dict[int, str] = {}
+        self.infra_fails: dict[int, int] = {}
+        self.entries: dict[int, PendingTrial] = {}  # trial_id -> entry
+        self.settled: dict[str, str] = {}  # sub_id -> terminal status
+        self.next_trial_id = 0
+        self._stop = False
+        self._farm = None
+        self._last_books_ts = 0.0
+        self._last_defrag_ts = 0.0
+        self._defrag_count = 0
+        self._defrag_moved_slices = 0
+        # sub_ids a defrag opened a window FOR (pending verdict) vs
+        # sub_ids that then actually placed: "unblocked" is recorded at
+        # placement, never at plan time — another tenant's small trial
+        # can steal the opened window and leave the starved trial
+        # blocked, and the books must not claim otherwise.
+        self._defrag_targets: set = set()
+        self._defrag_unblocked: list[str] = []
+        self._frag_max = 0.0
+        self._known_ids: set = set()
+        # Incremental books state: a persistent daemon must not
+        # re-read its whole append-only journal/ledger history on
+        # every books write (O(n²) over the daemon lifetime) — only
+        # newly appended complete lines are folded in.
+        self._qfold: dict = {}
+        self._qoffset = 0
+        self._tenant_fold: dict = {}
+        self._tenant_covered: dict = {}
+        self._led_offset = 0
+
+        from multidisttorch_tpu.telemetry.metrics import Histogram
+
+        self.queue_wait = Histogram(LATENCY_BUCKETS)
+        self.placement_latency = Histogram(LATENCY_BUCKETS)
+
+        self._recover()
+        if self.precompile:
+            from multidisttorch_tpu.compile.farm import PrecompilePool
+
+            self._farm = PrecompilePool()
+            # Warm everything recovered pending at boot.
+            for e in self.sched.pending_entries():
+                self._warm(e)
+
+    # -- submesh carving ---------------------------------------------
+
+    def _mesh_for(self, start: int, size: int):
+        """Carve the placement's contiguous slice block into a 1-D
+        data-parallel submesh (the allocator's contiguity guarantee is
+        what makes this the same carve rule as ``setup_groups``)."""
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from multidisttorch_tpu.parallel.mesh import DATA_AXIS, TrialMesh
+
+        k = self._devs_per_slice
+        lo, hi = start * k, (start + size) * k
+        grid = np.array(self._devices[lo:hi])
+        return TrialMesh(
+            group_id=start,
+            mesh=Mesh(grid, (DATA_AXIS,)),
+            global_ranks=tuple(range(lo, hi)),
+        )
+
+    # -- recovery -----------------------------------------------------
+
+    def _recover(self) -> None:
+        """Rebuild the scheduler's world from the durable journal: the
+        zero-lost-submissions contract. Settled/rejected submissions
+        stay settled; everything else re-enters the queue (ever-placed
+        work flagged ``resume_scan`` so it restores from its last valid
+        checkpoint instead of retraining from scratch)."""
+        folded = squeue.fold_queue(self.queue.load())
+        self._known_ids = set(folded)
+        prior_attempts = self.ledger.attempts()
+        # Trial-id high-water mark FIRST, before any re-admission: a
+        # submission the previous incarnation journaled but died before
+        # admitting goes through _admit() below, which assigns
+        # next_trial_id — if that still sat at 0, the recovered pending
+        # submission would collide with an existing trial's id and
+        # clobber its hash/attempt/tenant bookkeeping.
+        for rec in folded.values():
+            if rec.get("trial_id") is not None:
+                self.next_trial_id = max(
+                    self.next_trial_id, int(rec["trial_id"]) + 1
+                )
+        recovered = 0
+        for sid, rec in folded.items():
+            tid = rec.get("trial_id")
+            if rec["state"] in (squeue.SETTLED, squeue.REJECTED):
+                self.settled[sid] = rec.get("status") or rec["state"]
+                continue
+            sub = squeue.Submission.from_dict(
+                {
+                    "submission_id": sid,
+                    "tenant": rec["tenant"],
+                    "config": rec["config"],
+                    "priority": rec["priority"],
+                    "size": rec["size"],
+                    "deadline_s": rec.get("deadline_s"),
+                    "submit_ts": rec["submit_ts"],
+                }
+            )
+            if rec["state"] == squeue.PENDING:
+                self._admit(sub)
+                recovered += 1
+                continue
+            # admitted or placed: the trial id and hash are already
+            # assigned — rebuild the pending entry verbatim.
+            entry = self._entry_for(
+                sub,
+                trial_id=int(tid),
+                resume_scan=rec.get("placements", 0) > 0,
+            )
+            if entry is None:
+                # Config no longer valid against today's TrialConfig
+                # (version skew): reject rather than crash the daemon.
+                self.queue.rejected(
+                    sid,
+                    verdict=REJECT_INVALID,
+                    reason="recovered submission no longer parses",
+                )
+                self.settled[sid] = REJECT_INVALID
+                continue
+            chash = rec.get("config_hash") or config_hash(
+                asdict(entry.cfg)
+            )
+            self.chashes[entry.trial_id] = chash
+            self.attempts[entry.trial_id] = prior_attempts.get(chash, 0)
+            self.ledger.tag(
+                entry.trial_id,
+                tenant=sub.tenant,
+                priority=sub.priority,
+                submit_ts=sub.submit_ts,
+            )
+            self.entries[entry.trial_id] = entry
+            if rec["state"] == squeue.PLACED:
+                # The previous incarnation died with this trial on a
+                # submesh that no longer exists: journal the truth so
+                # every reader (console, client status, books) sees it
+                # WAITING, not running, for the whole recovery period.
+                self.queue.unplaced(
+                    sid,
+                    trial_id=entry.trial_id,
+                    reason="daemon restart recovery",
+                )
+            self.sched.push(entry, front=entry.resume_scan)
+            recovered += 1
+        if recovered:
+            log0(
+                f"sweep service: recovered {recovered} live submissions "
+                f"from {self.service_dir} (journal fold)"
+            )
+            _emit("service_recovered", submissions=recovered)
+
+    # -- admission ----------------------------------------------------
+
+    def _config_from(self, sub: squeue.Submission, trial_id: int):
+        """Build the TrialConfig, or None when the submission's config
+        dict names unknown fields / bad values (rejected_invalid)."""
+        from multidisttorch_tpu.hpo.driver import TrialConfig
+
+        allowed = {
+            f.name for f in TrialConfig.__dataclass_fields__.values()
+        } - {"trial_id"}
+        cfg = dict(sub.config)
+        if not set(cfg) <= allowed:
+            return None
+        try:
+            built = TrialConfig(trial_id=trial_id, **cfg)
+            # Cheap sanity: these feed array shapes.
+            if built.epochs < 1 or built.batch_size < 1:
+                return None
+            return built
+        except (TypeError, ValueError):
+            return None
+
+    def _entry_for(
+        self,
+        sub: squeue.Submission,
+        *,
+        trial_id: int,
+        resume_scan: bool = False,
+    ) -> Optional[PendingTrial]:
+        from multidisttorch_tpu.hpo.driver import (
+            config_is_stackable,
+            predicted_cost,
+            stack_bucket_key,
+        )
+
+        cfg = self._config_from(sub, trial_id)
+        if cfg is None or sub.size > self.n_slices:
+            return None
+        bucket = (
+            stack_bucket_key(cfg)
+            if config_is_stackable(cfg)
+            else ("unstackable", trial_id)
+        )
+        return PendingTrial(
+            sub_id=sub.submission_id,
+            tenant=sub.tenant,
+            priority=sub.priority,
+            cfg=cfg,
+            bucket=bucket,
+            size=sub.size,
+            cost=float(
+                predicted_cost(cfg, len(self.train_data)) * sub.size
+            ),
+            submit_ts=sub.submit_ts,
+            trial_id=trial_id,
+            resume_scan=resume_scan,
+        )
+
+    def _admit(self, sub: squeue.Submission) -> None:
+        verdict, reason = self.sched.admit_verdict(sub.tenant)
+        if verdict == ADMIT:
+            tid = self.next_trial_id
+            entry = self._entry_for(sub, trial_id=tid)
+            if entry is None:
+                verdict, reason = (
+                    REJECT_INVALID,
+                    "config does not parse as a TrialConfig (unknown "
+                    f"fields or bad values), or size {sub.size} exceeds "
+                    f"the {self.n_slices}-slice world",
+                )
+        if verdict != ADMIT:
+            self.queue.rejected(
+                sub.submission_id, verdict=verdict, reason=reason
+            )
+            self.settled[sub.submission_id] = verdict
+            _emit(
+                "submission_rejected",
+                sub_id=sub.submission_id,
+                tenant=sub.tenant,
+                verdict=verdict,
+                reason=reason,
+            )
+            return
+        self.next_trial_id = tid + 1
+        chash = config_hash(asdict(entry.cfg))
+        self.chashes[tid] = chash
+        self.attempts.setdefault(tid, 0)
+        self.ledger.tag(
+            tid,
+            tenant=sub.tenant,
+            priority=sub.priority,
+            submit_ts=sub.submit_ts,
+        )
+        self.entries[tid] = entry
+        self.queue.admitted(
+            sub.submission_id,
+            trial_id=tid,
+            chash=chash,
+            bucket=str(entry.bucket),
+        )
+        self.sched.push(entry)
+        _emit(
+            "submission_admitted",
+            trial_id=tid,
+            sub_id=sub.submission_id,
+            tenant=sub.tenant,
+            priority=sub.priority,
+            size=sub.size,
+            bucket=str(entry.bucket),
+        )
+        self._warm(entry)
+
+    def _warm(self, entry: PendingTrial) -> None:
+        """Admission-time executable warming (PR 7): submit the trial's
+        programs to the farm against a PREDICTED submesh (the first
+        free block its size fits — a misprediction is just a registry
+        miss and an inline compile at placement)."""
+        if self._farm is None:
+            return
+        try:
+            start = next(
+                (
+                    s
+                    for s, n in self.pool.free_runs()
+                    if n >= entry.size
+                ),
+                0,
+            )
+            mesh = self._mesh_for(start, entry.size)
+            self._farm.plan_sweep(
+                [("single", [(entry.trial_id, entry.cfg)])],
+                [mesh],
+                max_lanes=self.max_lanes,
+            )
+        except Exception:  # noqa: BLE001 — warming is best-effort
+            pass
+
+    # -- placement ----------------------------------------------------
+
+    def _start_placement(self, p: Placement) -> None:
+        from multidisttorch_tpu.hpo.driver import (
+            _StackedBucketRun,
+            _TrialRun,
+        )
+
+        t0 = time.perf_counter()
+        now = time.time()
+        mesh = self._mesh_for(p.start, p.size)
+        stacked = len(p.members) >= 2
+        try:
+            if stacked:
+                run = _StackedBucketRun(
+                    mesh,
+                    [(e.trial_id, e.cfg) for e in p.members],
+                    self.train_data,
+                    self.test_data,
+                    self.service_dir,
+                    max_lanes=self.max_lanes,
+                    save_checkpoint=self.save_checkpoints,
+                    verbose=self.verbose,
+                    retry=self.retry,
+                    ledger=self.ledger,
+                    attempts=self.attempts,
+                    chashes=self.chashes,
+                    infra_fails=self.infra_fails,
+                )
+            else:
+                e = p.members[0]
+                self.attempts[e.trial_id] = (
+                    self.attempts.get(e.trial_id, 0) + 1
+                )
+                self.ledger.attempt_start(
+                    e.trial_id,
+                    self.chashes[e.trial_id],
+                    self.attempts[e.trial_id],
+                )
+                run = _TrialRun(
+                    mesh,
+                    e.cfg,
+                    self.train_data,
+                    self.test_data,
+                    self.service_dir,
+                    save_images=False,
+                    save_checkpoint=self.save_checkpoints,
+                    verbose=self.verbose,
+                    resume="scan" if e.resume_scan else False,
+                    ckpt_keep_last=self.ckpt_keep_last,
+                    attempt=self.attempts[e.trial_id],
+                )
+        except Exception as exc:  # noqa: BLE001 — setup isolation
+            self.pool.free(p.start, p.size)
+            self._setup_failed(p, exc)
+            return
+        ap = _Active(
+            placement_id=p.placement_id,
+            start=p.start,
+            size=p.size,
+            stacked=stacked,
+            run=run,
+            gen=run.run(),
+            entries={e.trial_id: e for e in p.members},
+            place_ts=now,
+            construct_s=time.perf_counter() - t0,
+            tenants=tuple(sorted({e.tenant for e in p.members})),
+        )
+        self.active[p.placement_id] = ap
+        for e in p.members:
+            if e.sub_id in self._defrag_targets:
+                # The defrag verdict lands only now: the starved trial
+                # actually got a submesh.
+                self._defrag_targets.discard(e.sub_id)
+                self._defrag_unblocked.append(e.sub_id)
+            self.queue_wait.observe(max(0.0, now - e.submit_ts))
+            self.queue.placed(
+                e.sub_id,
+                trial_id=e.trial_id,
+                start=p.start,
+                size=p.size,
+                lanes=len(p.members),
+                stacked=stacked,
+                resumed=e.resume_scan,
+            )
+            _emit(
+                "trial_placed",
+                trial_id=e.trial_id,
+                group_id=p.start,
+                sub_id=e.sub_id,
+                tenant=e.tenant,
+                start=p.start,
+                size=p.size,
+                lanes=len(p.members),
+                stacked=stacked,
+                queue_wait_s=round(max(0.0, now - e.submit_ts), 4),
+            )
+
+    def _setup_failed(self, p: Placement, exc: BaseException) -> None:
+        """Placement construction failed before any lane existed:
+        retry each member within the infra budget (as a classic run —
+        scan-resume recovers whatever checkpoints exist), else settle
+        it failed. Preemption propagates (the daemon is going away)."""
+        error_text = f"{type(exc).__name__}: {exc}"
+        fclass = classify_failure(exc)
+        if fclass == PREEMPTION:
+            for e in p.members:
+                self._requeue(e, reason=f"preempted at setup: {error_text}")
+            raise exc
+        for e in p.members:
+            tid = e.trial_id
+            if self.attempts.get(tid, 0) == 0:
+                self.attempts[tid] = 1
+                self.ledger.attempt_start(tid, self.chashes[tid], 1)
+            fails = self.infra_fails[tid] = (
+                self.infra_fails.get(tid, 0) + 1
+            )
+            if (
+                fclass == INFRA
+                and self.retry is not None
+                and self.retry.should_retry(fails, INFRA)
+            ):
+                self.ledger.attempt_end(
+                    tid, self.chashes[tid], self.attempts[tid],
+                    "retrying", error=error_text,
+                )
+                self._requeue(
+                    e,
+                    reason=f"setup retry: {error_text}",
+                    backoff_s=self.retry.backoff_s(fails, key=tid),
+                )
+            else:
+                self.ledger.attempt_end(
+                    tid, self.chashes[tid], self.attempts[tid],
+                    "failed", error=error_text,
+                )
+                self._settle(e, status="failed", error=error_text)
+
+    def _requeue(
+        self,
+        entry: PendingTrial,
+        *,
+        reason: str,
+        backoff_s: float = 0.0,
+        pinned_start: Optional[int] = None,
+        front: bool = False,
+    ) -> None:
+        self.queue.unplaced(
+            entry.sub_id, trial_id=entry.trial_id, reason=reason
+        )
+        entry.resume_scan = True
+        entry.pinned_start = pinned_start
+        entry.not_before = time.time() + backoff_s
+        entry.blocked_since = None
+        self.sched.push(entry, front=front)
+
+    def _settle(
+        self, entry: PendingTrial, *, status: str, error: str = ""
+    ) -> None:
+        self.queue.settled(
+            entry.sub_id,
+            trial_id=entry.trial_id,
+            status=status,
+            error=error,
+        )
+        self.settled[entry.sub_id] = status
+        # A persistent daemon must not grow per-trial bookkeeping
+        # without bound: once settled, a trial never retries, re-places
+        # or re-ledgers, so its live-state entries are dead weight
+        # (the journal and ledger remain the durable record). The
+        # settled map and dedup id set stay — they are small strings
+        # and the idempotence/recovery contracts need them.
+        tid = entry.trial_id
+        for d in (
+            self.entries, self.attempts, self.chashes,
+            self.infra_fails, self.ledger.tags,
+        ):
+            d.pop(tid, None)
+        self._defrag_targets.discard(entry.sub_id)
+        _emit(
+            "submission_settled",
+            trial_id=entry.trial_id,
+            sub_id=entry.sub_id,
+            tenant=entry.tenant,
+            status=status,
+            wait_to_settle_s=round(time.time() - entry.submit_ts, 3),
+        )
+
+    # -- stepping -----------------------------------------------------
+
+    def _retire(self, ap: _Active) -> None:
+        del self.active[ap.placement_id]
+        self.pool.free(ap.start, ap.size)
+
+    def _step_actives(self) -> bool:
+        """One cooperative dispatch per live placement; returns whether
+        any placement made progress (drives the idle sleep)."""
+        progressed = False
+        for pid in list(self.active):
+            ap = self.active.get(pid)
+            if ap is None:
+                continue
+            try:
+                next(ap.gen)
+                progressed = True
+                if not ap.first_step_done:
+                    ap.first_step_done = True
+                    # Placement latency: placement decision → the first
+                    # cooperative step returning (run construction +
+                    # state init + compile claim + first dispatch) —
+                    # the "submission is actually training" moment.
+                    self.placement_latency.observe(
+                        max(0.0, time.time() - ap.place_ts)
+                    )
+            except StopIteration:
+                self._completed(ap)
+                progressed = True
+            except Exception as exc:  # noqa: BLE001 — failure isolation
+                self._placement_failed(ap, exc)
+                progressed = True
+        return progressed
+
+    def _completed(self, ap: _Active) -> None:
+        self._retire(ap)
+        if ap.stacked:
+            results = ap.run.results
+            unfinished = {tid for tid, _ in ap.run.unfinished()}
+        else:
+            e = next(iter(ap.entries.values()))
+            run = ap.run
+            run.result.attempt = self.attempts[e.trial_id]
+            self.ledger.attempt_end(
+                e.trial_id,
+                self.chashes[e.trial_id],
+                self.attempts[e.trial_id],
+                "completed",
+                summary=self._result_summary(run.result),
+            )
+            results = {e.trial_id: run.result}
+            unfinished = set()
+        for tid, entry in ap.entries.items():
+            if tid in unfinished:
+                # A lane the bucket never got to (should not happen on
+                # clean StopIteration, but stay safe): requeue.
+                self._requeue(entry, reason="bucket ended before lane ran")
+                continue
+            r = results.get(tid)
+            status = r.status if r is not None else "completed"
+            if status == "resumed_complete":
+                status = "completed"
+            self._settle(
+                entry,
+                status=status,
+                error=r.error if r is not None else "",
+            )
+
+    def _placement_failed(self, ap: _Active, exc: BaseException) -> None:
+        error_text = f"{type(exc).__name__}: {exc}"
+        fclass = classify_failure(exc)
+        self._retire(ap)
+        if not ap.stacked:
+            try:
+                ap.run._join_ckpt()
+            except Exception as ce:  # noqa: BLE001
+                error_text += f"; also: {type(ce).__name__}: {ce}"
+        if fclass == PREEMPTION:
+            # The process is going away: record this placement, then
+            # drain everything (the daemon's exit contract) and let the
+            # exception propagate to serve().
+            self._record_unplaced(ap, reason=f"preempted: {error_text}")
+            raise exc
+        if ap.stacked:
+            # Lane-scoped faults never reach here (mask-and-refill
+            # absorbed them); this is bucket-wide breakage. Retired
+            # lanes keep their settled results; live/queued members
+            # retry as classic runs or fail.
+            results = ap.run.results
+            for tid, entry in ap.entries.items():
+                if tid in results and results[tid].status in (
+                    "completed", "diverged", "failed",
+                ):
+                    self._settle(
+                        entry,
+                        status=results[tid].status,
+                        error=results[tid].error,
+                    )
+                    continue
+                self._member_failed(ap, entry, error_text, INFRA)
+            return
+        entry = next(iter(ap.entries.values()))
+        if fclass == DIVERGENCE:
+            run = ap.run
+            run.result.status = "diverged"
+            run.result.error = error_text
+            run.result.steps = run._step_no
+            self.ledger.attempt_end(
+                entry.trial_id,
+                self.chashes[entry.trial_id],
+                self.attempts[entry.trial_id],
+                "diverged",
+                error=error_text,
+                summary=self._result_summary(run.result),
+            )
+            self._settle(entry, status="diverged", error=error_text)
+            return
+        self._member_failed(ap, entry, error_text, fclass)
+
+    def _member_failed(
+        self, ap: _Active, entry: PendingTrial, error_text: str, fclass
+    ) -> None:
+        tid = entry.trial_id
+        progress = self._attempt_progress(ap, tid)
+        fails = self.infra_fails[tid] = self.infra_fails.get(tid, 0) + 1
+        if (
+            fclass == INFRA
+            and self.retry is not None
+            and self.retry.should_retry(fails, INFRA)
+        ):
+            self.ledger.attempt_end(
+                tid, self.chashes[tid], self.attempts.get(tid, 1),
+                "retrying", error=error_text, summary=progress,
+            )
+            self._requeue(
+                entry,
+                reason=f"infra retry: {error_text}",
+                backoff_s=self.retry.backoff_s(fails, key=tid),
+            )
+        else:
+            self.ledger.attempt_end(
+                tid, self.chashes[tid], self.attempts.get(tid, 1),
+                "failed", error=error_text, summary=progress,
+            )
+            self._settle(entry, status="failed", error=error_text)
+
+    @staticmethod
+    def _attempt_progress(ap: _Active, tid: int) -> dict:
+        if ap.stacked:
+            got = ap.run.lane_progress(tid)
+            return got or {"resumed_from_step": 0, "steps_at_failure": 0}
+        run = ap.run
+        return {
+            "resumed_from_step": run.result.resumed_from_step,
+            "steps_at_failure": run._step_no,
+        }
+
+    @staticmethod
+    def _result_summary(result) -> dict:
+        from multidisttorch_tpu.hpo.driver import _result_summary
+
+        return _result_summary(result)
+
+    # -- defrag -------------------------------------------------------
+
+    def _maybe_defrag(self, now: float) -> None:
+        if not self.defrag_enabled or not self.active:
+            return
+        if now - self._last_defrag_ts < self.defrag_cooldown_s:
+            return
+        for starved in self.sched.starved_entries(
+            threshold_s=self.starvation_s, now=now
+        ):
+            if self.pool.can_fit(starved.size):
+                continue  # unblocked since it was stamped
+            if self.pool.free_total < starved.size:
+                # Not fragmentation but raw capacity: no amount of
+                # compaction frees slices a running trial owns — only
+                # completions do. Defrag would be pure churn.
+                continue
+            blocks = [
+                PlacedBlock(
+                    placement_id=pid,
+                    start=ap.start,
+                    size=ap.size,
+                    movable=ap.movable(),
+                )
+                for pid, ap in self.active.items()
+            ]
+            plan = plan_defrag(
+                self.pool, blocks, starved.size
+            )
+            if plan is None:
+                _emit(
+                    "defrag_blocked",
+                    sub_id=starved.sub_id,
+                    want_size=starved.size,
+                    reason="no feasible window (immovable placements "
+                    "or no room to re-home victims)",
+                )
+                continue
+            self._execute_defrag(plan, starved, now)
+            return  # one defrag per cooldown window
+
+    def _execute_defrag(self, plan, starved: PendingTrial, now) -> None:
+        t0 = time.perf_counter()
+        self._last_defrag_ts = now
+        frag_before = self.pool.fragmentation()
+        _emit(
+            "defrag_start",
+            sub_id=starved.sub_id,
+            trial_id=starved.trial_id,
+            tenant=starved.tenant,
+            want_size=starved.size,
+            starved_s=round(now - (starved.blocked_since or now), 3),
+            fragmentation=round(frag_before, 4),
+            free_runs=self.pool.free_runs(),
+            moves=len(plan.moves),
+        )
+        moved = 0
+        for pid, new_start in plan.moves:
+            ap = self.active.get(pid)
+            if ap is None or ap.stacked:
+                continue  # raced a completion; window may open anyway
+            entry = next(iter(ap.entries.values()))
+            tid = entry.trial_id
+            # Checkpoint-drain the victim: close the generator at its
+            # current yield point, land any in-flight checkpoint write,
+            # and record the controlled preemption — the migrated
+            # attempt resumes from its last durable epoch boundary via
+            # the scan-back restore (PR 5's machinery).
+            try:
+                ap.gen.close()
+            except Exception:  # noqa: BLE001 — teardown must go on
+                pass
+            try:
+                ap.run._join_ckpt()
+            except Exception:  # noqa: BLE001
+                pass
+            self.ledger.attempt_end(
+                tid,
+                self.chashes[tid],
+                self.attempts.get(tid, 1),
+                "preempted",
+                error="defrag migration",
+                summary=self._attempt_progress(ap, tid),
+            )
+            self._retire(ap)
+            # The victim re-enters the queue FRONT, pinned to the
+            # planner's relocation target (outside the window); the
+            # next scheduling pass serves it first, so it claims its
+            # pin before the starved trial claims the opened window.
+            # No pre-reservation: the pool must show the window free
+            # or the starved trial's own allocation would fail.
+            _emit(
+                "defrag_move",
+                trial_id=tid,
+                sub_id=entry.sub_id,
+                tenant=entry.tenant,
+                src=ap.start,
+                dst=new_start,
+                size=ap.size,
+            )
+            _emit(
+                "trial_migrated",
+                trial_id=tid,
+                src_group=ap.start,
+                dst_group=new_start,
+                reason="defrag",
+            )
+            self._requeue(
+                entry,
+                reason="defrag migration",
+                pinned_start=new_start,
+                front=True,
+            )
+            moved += ap.size
+        self._defrag_count += 1
+        self._defrag_moved_slices += moved
+        self._defrag_targets.add(starved.sub_id)
+        _emit(
+            "defrag_end",
+            sub_id=starved.sub_id,
+            want_size=starved.size,
+            window_start=plan.window_start,
+            window_size=plan.window_size,
+            moved_slices=moved,
+            freed_contiguous=self.pool.largest_free_run(),
+            fragmentation_before=round(frag_before, 4),
+            fragmentation_after=round(self.pool.fragmentation(), 4),
+            wall_s=round(time.perf_counter() - t0, 4),
+        )
+
+    # -- drain / books ------------------------------------------------
+
+    def stop(self) -> None:
+        """Request a graceful drain (signal-handler-safe: just a flag)."""
+        self._stop = True
+
+    def _record_unplaced(self, ap: _Active, *, reason: str) -> None:
+        """One placement's drain bookkeeping: settled lanes settle,
+        everything live is recorded preempted + requeued."""
+        if ap.stacked:
+            ap.run.record_preempted(reason)
+            results = ap.run.results
+            for tid, entry in ap.entries.items():
+                r = results.get(tid)
+                if r is not None and r.status in SETTLED_STATUSES:
+                    self._settle(entry, status=r.status, error=r.error)
+                else:
+                    self.queue.unplaced(
+                        entry.sub_id, trial_id=tid, reason=reason
+                    )
+        else:
+            entry = next(iter(ap.entries.values()))
+            tid = entry.trial_id
+            try:
+                ap.run._join_ckpt()
+            except Exception:  # noqa: BLE001
+                pass
+            self.ledger.attempt_end(
+                tid,
+                self.chashes[tid],
+                self.attempts.get(tid, 1),
+                "preempted",
+                error=reason,
+                summary=self._attempt_progress(ap, tid),
+            )
+            self.queue.unplaced(entry.sub_id, trial_id=tid, reason=reason)
+
+    def _drain(self, *, reason: str) -> None:
+        _emit("service_drain", in_flight=len(self.active), reason=reason)
+        for pid in list(self.active):
+            ap = self.active.pop(pid)
+            try:
+                ap.gen.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self.pool.free(ap.start, ap.size)
+            self._record_unplaced(ap, reason=reason)
+        self.write_books()
+
+    def _advance_folds(self) -> None:
+        """Feed newly-appended journal/ledger lines through the
+        persistent folds. A file shorter than its offset means a
+        rewrite under us (e.g. the supervisor compacted the ledger
+        between worlds) — reset that fold and start over."""
+        try:
+            if os.path.getsize(self.queue.path) < self._qoffset:
+                self._qfold.clear()
+                self._qoffset = 0
+        except OSError:
+            pass
+        recs, self._qoffset = squeue.read_jsonl_from(
+            self.queue.path, self._qoffset
+        )
+        squeue.fold_queue_into(self._qfold, recs)
+        if recs:
+            # The books never read a settled submission's config blob;
+            # dropping it keeps the persistent fold's footprint at a
+            # few small strings per lifetime submission.
+            for rec in self._qfold.values():
+                if rec["state"] in (squeue.SETTLED, squeue.REJECTED):
+                    rec.pop("config", None)
+        try:
+            if os.path.getsize(self.ledger.path) < self._led_offset:
+                self._tenant_fold.clear()
+                self._tenant_covered.clear()
+                self._led_offset = 0
+        except OSError:
+            pass
+        recs, self._led_offset = squeue.read_jsonl_from(
+            self.ledger.path, self._led_offset
+        )
+        fold_tenant_goodput_into(
+            self._tenant_fold, self._tenant_covered, recs
+        )
+
+    def books(self) -> dict:
+        self._advance_folds()
+        folded = self._qfold
+        stats = squeue.QueueStats.of(folded)
+        frag = self.pool.fragmentation()
+        self._frag_max = max(self._frag_max, frag)
+        return {
+            "generated_ts": time.time(),
+            "service_dir": self.service_dir,
+            "slices": self.n_slices,
+            "devices_per_slice": self._devs_per_slice,
+            "queue": {
+                "by_state": dict(sorted(stats.by_state.items())),
+                "by_tenant": {
+                    t: dict(sorted(v.items()))
+                    for t, v in sorted(stats.by_tenant.items())
+                },
+                "pending_now": self.sched.pending_count(),
+                "active_placements": len(self.active),
+            },
+            "tenants": finalize_tenant_goodput(self._tenant_fold),
+            "fair_share": self.sched.fair_share_report(),
+            "queue_wait": self.queue_wait.stats(),
+            "placement_latency": self.placement_latency.stats(),
+            "fragmentation": {
+                "now": round(frag, 4),
+                "max": round(self._frag_max, 4),
+                "free_slices": self.pool.free_total,
+                "largest_free_run": self.pool.largest_free_run(),
+            },
+            "defrag": {
+                "events": self._defrag_count,
+                "moved_slices": self._defrag_moved_slices,
+                "unblocked": list(self._defrag_unblocked),
+                "pending_unblock": sorted(self._defrag_targets),
+            },
+        }
+
+    def write_books(self) -> str:
+        path = os.path.join(self.service_dir, BOOKS_NAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.books(), f, indent=2, default=str)
+        os.replace(tmp, path)
+        return path
+
+    # -- the loop -----------------------------------------------------
+
+    def tick(self) -> bool:
+        """One service cycle; returns whether anything progressed (the
+        caller's idle-sleep signal). Factored out of :meth:`serve` so
+        tests can single-step the daemon deterministically."""
+        now = time.time()
+        fresh = self.queue.drain_intake(known_ids=self._known_ids)
+        for sub in fresh:
+            _emit(
+                "submission_received",
+                sub_id=sub.submission_id,
+                tenant=sub.tenant,
+                priority=sub.priority,
+                size=sub.size,
+            )
+            self._admit(sub)
+        placements = self.sched.schedule(
+            self.pool,
+            max_lanes=self.max_lanes,
+            now=now,
+            can_start=lambda e: now >= e.not_before,
+        )
+        for p in placements:
+            self._start_placement(p)
+        progressed = self._step_actives()
+        self._maybe_defrag(now)
+        if now - self._last_books_ts >= self.books_every_s:
+            self._last_books_ts = now
+            self.write_books()
+        return bool(fresh or placements or progressed)
+
+    def idle(self) -> bool:
+        """Nothing running, nothing schedulable, nothing in the spool."""
+        if self.active or self.sched.pending_count():
+            return False
+        d = squeue.intake_dir(self.service_dir)
+        try:
+            return not any(
+                n.endswith(".json") for n in os.listdir(d)
+            )
+        except OSError:
+            return True
+
+    def serve(
+        self,
+        *,
+        max_wall_s: Optional[float] = None,
+        exit_when_drained: bool = False,
+        idle_grace_s: float = 0.5,
+    ) -> dict:
+        """Run the daemon loop until stopped (drain), out of wall
+        budget, or — with ``exit_when_drained`` — the world goes idle
+        for ``idle_grace_s`` (the CI/bench drills' termination mode;
+        a production daemon runs without it and waits for work)."""
+        t0 = time.time()
+        idle_since: Optional[float] = None
+        _emit(
+            "service_start",
+            slices=self.n_slices,
+            max_lanes=self.max_lanes,
+            recovered=len(self.entries),
+        )
+        outcome = "drained"
+        try:
+            while True:
+                if self._stop:
+                    self._drain(reason="graceful drain (stop requested)")
+                    outcome = "preempted"
+                    break
+                if max_wall_s is not None and time.time() - t0 > max_wall_s:
+                    self._drain(reason="wall budget exhausted")
+                    outcome = "wall_budget"
+                    break
+                progressed = self.tick()
+                if exit_when_drained and self.idle():
+                    if idle_since is None:
+                        idle_since = time.time()
+                    elif time.time() - idle_since >= idle_grace_s:
+                        outcome = "idle"
+                        break
+                else:
+                    idle_since = None
+                if not progressed:
+                    time.sleep(self.idle_sleep_s)
+        except BaseException as exc:
+            # Preemption-class exits drain; anything else still lands
+            # the books before propagating (a failed daemon needs its
+            # story told more than a healthy one).
+            try:
+                self._drain(
+                    reason=f"daemon exception: {type(exc).__name__}: {exc}"
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+        self.write_books()
+        _emit("service_end", outcome=outcome, wall_s=round(time.time() - t0, 3))
+        if self._farm is not None:
+            self._farm.shutdown()
+        return {
+            "outcome": outcome,
+            "wall_s": round(time.time() - t0, 3),
+            "settled": dict(self.settled),
+            "books": self.books(),
+        }

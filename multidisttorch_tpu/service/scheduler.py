@@ -1,0 +1,515 @@
+"""Multi-tenant scheduling: admission, fair share, and bin-packing.
+
+Pure host-side logic — no JAX anywhere — so every scheduling invariant
+is property-testable in microseconds (tests/test_service.py):
+
+- **Admission control**: per-tenant pending quotas and a global
+  backpressure cap produce explicit verdicts (``admitted`` /
+  ``rejected_quota`` / ``rejected_backpressure``) — the service never
+  silently eats a submission it cannot schedule.
+- **Weighted fair share with priority lanes**: deficit round-robin
+  over tenants, implemented in its virtual-time (attained-service)
+  form because submesh service opportunities arrive irregularly — one
+  slice freeing at a time — rather than as a steady link. Priority
+  lanes are strict (lane 0 drains before lane 1 is considered); WITHIN
+  a lane each placement opportunity goes to the tenant whose
+  weight-normalized served cost is smallest, which converges to the
+  weight ratio under contention and can never starve a nonempty
+  tenant (its attained service freezes while others' grow).
+- **Shape-bucket bin-packing**: selected trials sharing a shape bucket
+  (PR 1's ``stack_bucket_key``) and submesh size co-pack into ONE
+  placement — one vmapped dispatch on one submesh, tenants mixed
+  freely — and a bucket is never split across submeshes mid-pass: an
+  open placement is filled to ``max_lanes`` before a second submesh is
+  allocated for the same bucket.
+- **Slice allocation**: the device world is carved into unit slices;
+  a size-``s`` trial needs ``s`` CONTIGUOUS slices (a submesh is a
+  contiguous device span — ``parallel/mesh.py``'s carving rule).
+  :class:`SlicePool` is the first-fit contiguous allocator plus the
+  fragmentation gauge the defrag policy (``service/defrag.py``) keys
+  off.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+# Admission verdicts (the queue journal's ``rejected.verdict`` values).
+ADMIT = "admitted"
+REJECT_QUOTA = "rejected_quota"
+REJECT_BACKPRESSURE = "rejected_backpressure"
+REJECT_INVALID = "rejected_invalid"
+
+
+@dataclass(frozen=True)
+class TenantPolicy:
+    """One tenant's scheduling contract.
+
+    ``weight`` sets the tenant's fair share within a priority lane
+    (served cost converges to the weight ratio under contention).
+    ``max_pending`` is the admission quota: submissions beyond it are
+    rejected with ``rejected_quota`` (the client resubmits later —
+    rejection is a backpressure signal, not a failure)."""
+
+    weight: float = 1.0
+    max_pending: int = 256
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"weight must be > 0, got {self.weight}")
+        if self.max_pending < 1:
+            raise ValueError(
+                f"max_pending must be >= 1, got {self.max_pending}"
+            )
+
+
+@dataclass
+class PendingTrial:
+    """One admitted-but-not-running trial in the scheduler's queues.
+
+    ``cfg`` and ``bucket`` are opaque to the scheduler (the runtime
+    supplies a TrialConfig and its stack-bucket key); ``cost`` is the
+    trial's predicted work (optimizer steps x size) — the DRR
+    currency. ``resume_scan`` marks a trial that must restore from
+    checkpoint (recovered after a crash, or migrated by defrag): such
+    trials never co-pack (stacked lanes cannot restore mid-trial) and
+    ``pinned_start`` asks for a specific slice block (a defrag
+    target)."""
+
+    sub_id: str
+    tenant: str
+    priority: int
+    cfg: object
+    bucket: object
+    size: int
+    cost: float
+    submit_ts: float
+    trial_id: int = -1
+    resume_scan: bool = False
+    pinned_start: Optional[int] = None
+    blocked_since: Optional[float] = None
+    enqueue_ts: float = 0.0
+    # Earliest wall time this entry may start (a retry's backoff);
+    # enforced by the runtime's ``can_start`` veto, so a backing-off
+    # entry never blocks its tenant's other work.
+    not_before: float = 0.0
+
+
+@dataclass
+class Placement:
+    """One scheduling decision: K co-packed trials on one slice block.
+
+    Every member shares ``(bucket, size)`` by construction; ``members``
+    has one entry per lane. The INVARIANT the packer maintains (and
+    tests enforce): a single ``schedule()`` pass opens
+    ``ceil(selected/max_lanes)`` placements per (bucket, size) — never
+    two partially-filled submeshes for the same bucket."""
+
+    placement_id: int
+    bucket: object
+    size: int
+    start: int
+    members: list = field(default_factory=list)  # [PendingTrial, ...]
+
+    @property
+    def lanes(self) -> int:
+        return len(self.members)
+
+
+class SlicePool:
+    """Contiguous allocator over ``n_slices`` unit slices.
+
+    First-fit lowest-start allocation (deterministic — restarted
+    daemons re-place recovered trials identically given the same queue
+    order). ``fragmentation()`` is the gauge the books export: the
+    fraction of free capacity NOT reachable by the largest contiguous
+    request (0.0 = one free run or nothing free; higher = more
+    fragmented)."""
+
+    def __init__(self, n_slices: int):
+        if n_slices < 1:
+            raise ValueError(f"n_slices must be >= 1, got {n_slices}")
+        self.n_slices = n_slices
+        self._free = [True] * n_slices
+
+    # -- queries ------------------------------------------------------
+
+    @property
+    def free_total(self) -> int:
+        return sum(self._free)
+
+    def free_runs(self) -> list[tuple[int, int]]:
+        """Maximal free runs as ``(start, length)``, ascending."""
+        runs = []
+        i = 0
+        while i < self.n_slices:
+            if self._free[i]:
+                j = i
+                while j < self.n_slices and self._free[j]:
+                    j += 1
+                runs.append((i, j - i))
+                i = j
+            else:
+                i += 1
+        return runs
+
+    def largest_free_run(self) -> int:
+        return max((n for _, n in self.free_runs()), default=0)
+
+    def fragmentation(self) -> float:
+        free = self.free_total
+        if free == 0:
+            return 0.0
+        return 1.0 - self.largest_free_run() / free
+
+    def can_fit(self, size: int) -> bool:
+        return self.largest_free_run() >= size
+
+    # -- mutation -----------------------------------------------------
+
+    def alloc(self, size: int) -> Optional[int]:
+        """First contiguous run of ``size`` slices, or None."""
+        for start, n in self.free_runs():
+            if n >= size:
+                self._mark(start, size, free=False)
+                return start
+        return None
+
+    def alloc_at(self, start: int, size: int) -> bool:
+        """Claim the exact block ``[start, start+size)`` if wholly free."""
+        if start < 0 or start + size > self.n_slices:
+            return False
+        if not all(self._free[start:start + size]):
+            return False
+        self._mark(start, size, free=False)
+        return True
+
+    def free(self, start: int, size: int) -> None:
+        for i in range(start, start + size):
+            if self._free[i]:
+                raise ValueError(
+                    f"double free of slice {i} (block {start}+{size})"
+                )
+        self._mark(start, size, free=True)
+
+    def _mark(self, start: int, size: int, *, free: bool) -> None:
+        for i in range(start, start + size):
+            self._free[i] = free
+
+
+class FairShareScheduler:
+    """Admission + DRR fair share + shape-bucket packing.
+
+    The runtime owns the slice pool and the trial runs; this class owns
+    WHO goes next. One ``schedule()`` call is one DRR pass: it mutates
+    the pool (allocating blocks for the placements it returns) and its
+    own queues, and keeps the fair-share evidence
+    (``contended_cost``) the bench's 10%-of-weights gate reads."""
+
+    def __init__(
+        self,
+        policies: Optional[dict[str, TenantPolicy]] = None,
+        *,
+        default_policy: Optional[TenantPolicy] = None,
+        max_total_pending: int = 4096,
+    ):
+        self.policies = dict(policies or {})
+        self.default_policy = default_policy or TenantPolicy()
+        self.max_total_pending = max_total_pending
+        # tenant -> priority -> FIFO of PendingTrial
+        self._pending: dict[str, dict[int, list[PendingTrial]]] = {}
+        self._rotation: list[str] = []  # stable service order for ties
+        # Weighted fair share in its VIRTUAL-TIME form (the
+        # opportunity-driven equivalent of deficit round robin for a
+        # submesh pool, where service opportunities arrive irregularly
+        # — one slice freeing at a time — instead of as a steady link):
+        # each tenant carries its normalized attained service
+        # v[t] = placed_cost / weight, every placement opportunity goes
+        # to the LEAST-attained tenant, and a tenant activating from
+        # idle starts at the current virtual time (no hoarded credit —
+        # DRR's reset-on-empty). Served cost then converges to the
+        # weight ratio under contention in BOTH regimes, and a nonempty
+        # tenant can never starve: its v freezes while others' grow, so
+        # it becomes the minimum in bounded time.
+        self._vsrv: dict[str, float] = {}
+        self._vtime = 0.0
+        self._next_placement_id = 0
+        # Fair-share evidence: cost placed per tenant while at least
+        # one OTHER tenant also had pending work (uncontended
+        # placements say nothing about fairness and are excluded).
+        self.contended_cost: dict[str, float] = {}
+        self.placed_cost: dict[str, float] = {}
+
+    # -- admission ----------------------------------------------------
+
+    def policy(self, tenant: str) -> TenantPolicy:
+        return self.policies.get(tenant, self.default_policy)
+
+    def pending_count(self, tenant: Optional[str] = None) -> int:
+        if tenant is None:
+            return sum(
+                len(q)
+                for lanes in self._pending.values()
+                for q in lanes.values()
+            )
+        return sum(len(q) for q in self._pending.get(tenant, {}).values())
+
+    def admit_verdict(self, tenant: str) -> tuple[str, str]:
+        """Admission decision for one more submission from ``tenant``
+        given the CURRENT queue depth (the runtime calls this before
+        :meth:`push`)."""
+        total = self.pending_count()
+        if total >= self.max_total_pending:
+            return (
+                REJECT_BACKPRESSURE,
+                f"service backlog at {total} >= {self.max_total_pending}; "
+                "resubmit later",
+            )
+        mine = self.pending_count(tenant)
+        quota = self.policy(tenant).max_pending
+        if mine >= quota:
+            return (
+                REJECT_QUOTA,
+                f"tenant {tenant!r} has {mine} pending >= quota {quota}",
+            )
+        return ADMIT, ""
+
+    def push(self, entry: PendingTrial, *, front: bool = False) -> None:
+        """Queue an admitted trial (``front=True`` requeues a
+        recovered/migrated trial ahead of its tenant's backlog — it
+        already waited once)."""
+        if self.pending_count(entry.tenant) == 0:
+            # Activating from idle: start at the current virtual time.
+            # Idle time must not bank credit a tenant later spends as a
+            # monopolizing burst (DRR's reset-on-empty, SFQ's start-tag
+            # rule).
+            self._vsrv[entry.tenant] = max(
+                self._vsrv.get(entry.tenant, 0.0), self._vtime
+            )
+        lanes = self._pending.setdefault(entry.tenant, {})
+        q = lanes.setdefault(int(entry.priority), [])
+        entry.enqueue_ts = time.time()
+        if front:
+            q.insert(0, entry)
+        else:
+            q.append(entry)
+        if entry.tenant not in self._rotation:
+            self._rotation.append(entry.tenant)
+
+    def pending_entries(self) -> list[PendingTrial]:
+        out = []
+        for lanes in self._pending.values():
+            for pri in sorted(lanes):
+                out.extend(lanes[pri])
+        return out
+
+    # -- the DRR pass -------------------------------------------------
+
+    def _lanes_present(self) -> list[int]:
+        pris: set[int] = set()
+        for lanes in self._pending.values():
+            for pri, q in lanes.items():
+                if q:
+                    pris.add(pri)
+        return sorted(pris)
+
+    def _tenants_with_work(self, pri: int) -> list[str]:
+        return [
+            t
+            for t in self._rotation
+            if self._pending.get(t, {}).get(pri)
+        ]
+
+    def schedule(
+        self,
+        pool: SlicePool,
+        *,
+        max_lanes: int = 4,
+        now: Optional[float] = None,
+        can_start: Optional[Callable[[PendingTrial], bool]] = None,
+    ) -> list[Placement]:
+        """One scheduling pass. Allocates slice blocks from ``pool``
+        and dequeues the selected trials; whatever could not be placed
+        (no deficit yet, or no contiguous block of its size — the
+        ``blocked_since`` stamp defrag watches) stays queued.
+
+        ``can_start`` lets the runtime veto an otherwise-placeable
+        entry (e.g. its executable is still precompiling) without
+        consuming its fair-share turn.
+        """
+        now = time.time() if now is None else now
+        placements: list[Placement] = []
+        # One placement per (bucket, size) may sit open below max_lanes
+        # at any moment of the pass — the never-split-a-bucket rule.
+        open_placements: dict[tuple, Placement] = {}
+        multi_tenant_backlog = (
+            sum(1 for t in self._rotation if self.pending_count(t) > 0)
+            >= 2
+        )
+
+        for pri in self._lanes_present():
+            # Strict priority: this lane is served to exhaustion (of
+            # slices or placeable work) before the next lane starts.
+            # Within the lane: every placement opportunity goes to the
+            # least-attained tenant first (see the virtual-time notes
+            # in __init__); re-sorted after each placement, since the
+            # served tenant's v just advanced.
+            while True:
+                served = False
+                for tenant in sorted(
+                    self._tenants_with_work(pri),
+                    key=lambda t: (self._vsrv.get(t, 0.0), t),
+                ):
+                    if self._serve_one(
+                        tenant, pri, pool, open_placements, placements,
+                        max_lanes=max_lanes, now=now,
+                        contended=multi_tenant_backlog,
+                        can_start=can_start,
+                    ):
+                        served = True
+                        break
+                if not served:
+                    break
+        return placements
+
+    def _serve_one(
+        self,
+        tenant: str,
+        pri: int,
+        pool: SlicePool,
+        open_placements: dict,
+        placements: list,
+        *,
+        max_lanes: int,
+        now: float,
+        contended: bool,
+        can_start: Optional[Callable[[PendingTrial], bool]],
+    ) -> bool:
+        """Try to place ONE trial of ``tenant`` in lane ``pri``
+        (FIFO within the lane). Scans past entries blocked on slice
+        shape (stamping ``blocked_since`` — defrag's starvation clock)
+        so one large trial cannot convoy its tenant's small ones."""
+        q = self._pending.get(tenant, {}).get(pri, [])
+        for idx, entry in enumerate(q):
+            # A pinned entry is a defrag victim being re-homed: it
+            # already paid its cost when first placed, so its
+            # re-placement advances no virtual time and is never
+            # deferred (a victim left waiting its turn would watch its
+            # relocation target be stolen).
+            pinned = entry.pinned_start is not None
+            if can_start is not None and not can_start(entry):
+                continue
+            pack_key = (entry.bucket, entry.size)
+            open_p = open_placements.get(pack_key)
+            attach = (
+                open_p is not None
+                and open_p.lanes < max_lanes
+                and not entry.resume_scan
+                and entry.pinned_start is None
+            )
+            if attach:
+                placement = open_p
+            else:
+                start = None
+                if entry.pinned_start is not None:
+                    if pool.alloc_at(entry.pinned_start, entry.size):
+                        start = entry.pinned_start
+                if start is None:
+                    start = pool.alloc(entry.size)
+                if start is None:
+                    # No contiguous block of this size: blocked. Stamp
+                    # the starvation clock and look past it — smaller
+                    # work behind it may still fit.
+                    if entry.blocked_since is None:
+                        entry.blocked_since = now
+                    continue
+                placement = Placement(
+                    placement_id=self._next_placement_id,
+                    bucket=entry.bucket,
+                    size=entry.size,
+                    start=start,
+                )
+                self._next_placement_id += 1
+                placements.append(placement)
+                # resume_scan trials run classic (no lane restore into
+                # a stacked bucket), so their placement never opens for
+                # co-packing.
+                if not entry.resume_scan and entry.pinned_start is None:
+                    open_placements[pack_key] = placement
+            placement.members.append(entry)
+            if placement.lanes >= max_lanes:
+                open_placements.pop((entry.bucket, entry.size), None)
+            q.pop(idx)
+            entry.blocked_since = None
+            if not pinned:
+                v = self._vsrv.get(tenant, 0.0)
+                self._vtime = max(self._vtime, v)
+                self._vsrv[tenant] = (
+                    v + entry.cost / self.policy(tenant).weight
+                )
+                self.placed_cost[tenant] = (
+                    self.placed_cost.get(tenant, 0.0) + entry.cost
+                )
+                if contended:
+                    self.contended_cost[tenant] = (
+                        self.contended_cost.get(tenant, 0.0) + entry.cost
+                    )
+            return True
+        return False
+
+    # -- starvation ---------------------------------------------------
+
+    def starved_entries(
+        self, *, threshold_s: float, now: Optional[float] = None
+    ) -> list[PendingTrial]:
+        """Pending trials blocked on slice SHAPE for longer than the
+        threshold — the defrag trigger. Ordered oldest-starved first."""
+        now = time.time() if now is None else now
+        out = [
+            e
+            for e in self.pending_entries()
+            if e.blocked_since is not None
+            and now - e.blocked_since >= threshold_s
+        ]
+        out.sort(key=lambda e: e.blocked_since)
+        return out
+
+    # -- books --------------------------------------------------------
+
+    def fair_share_report(self) -> dict:
+        """Observed contended-cost shares vs configured weights — the
+        bench gate's input. ``ratio_to_weight`` of 1.0 means the tenant
+        received exactly its weighted share of contended placements."""
+        total_c = sum(self.contended_cost.values())
+        tenants = sorted(
+            set(self.placed_cost) | set(self.contended_cost)
+        )
+        total_w = sum(self.policy(t).weight for t in tenants) or 1.0
+        report = {}
+        for t in tenants:
+            w = self.policy(t).weight
+            share = (
+                self.contended_cost.get(t, 0.0) / total_c
+                if total_c
+                else None
+            )
+            expected = w / total_w
+            report[t] = {
+                "weight": w,
+                "placed_cost": round(self.placed_cost.get(t, 0.0), 3),
+                "contended_cost": round(
+                    self.contended_cost.get(t, 0.0), 3
+                ),
+                "contended_share": (
+                    round(share, 4) if share is not None else None
+                ),
+                "expected_share": round(expected, 4),
+                "ratio_to_weight": (
+                    round(share / expected, 4)
+                    if share is not None and expected
+                    else None
+                ),
+            }
+        return report

@@ -334,6 +334,11 @@ class SweepFold:
         # single-host stream; the fleet console folds a merged stream
         # through the same class.
         self.hosts: dict[int, int] = {}
+        # Per-tenant books folded off tenant-tagged attempt events (the
+        # sweep service's ledger stamps tenant/priority/submit_ts on
+        # every attempt record — hpo/ledger.py): goodput and settle
+        # accounting keyed by tenant. Empty on untagged streams.
+        self.tenants: dict[str, dict] = {}
 
     def _trial(self, tid: int) -> dict:
         return self.trials.setdefault(
@@ -511,6 +516,8 @@ class SweepFold:
         if kind == "attempt_start":
             t["attempts"] = max(t["attempts"], int(ev.get("attempt") or 0))
             t["status"] = "in_flight"
+            if data.get("tenant") is not None:
+                t["tenant"] = data["tenant"]
             self._attempt_ts[int(tid)] = ts
         elif kind == "first_dispatch":
             start = self._attempt_ts.get(int(tid))
@@ -548,12 +555,30 @@ class SweepFold:
             # attempt that never reported (killed without attempt_end —
             # its work is visible only as this resume point).
             covered = self._covered.get(int(tid), 0)
-            self.executed += max(0, done - resumed) + max(
-                0, resumed - covered
-            )
+            increment = max(0, done - resumed) + max(0, resumed - covered)
+            self.executed += increment
             self._covered[int(tid)] = max(covered, done)
             if status in SETTLED_STATUSES:
                 self.useful += done
+            tenant = data.get("tenant")
+            if tenant is not None:
+                t["tenant"] = tenant
+                tb = self.tenants.setdefault(
+                    str(tenant),
+                    {
+                        "attempts": 0,
+                        "settled": 0,
+                        "useful_steps": 0,
+                        "executed_steps": 0,
+                        "trials": set(),
+                    },
+                )
+                tb["attempts"] += 1
+                tb["trials"].add(int(tid))
+                tb["executed_steps"] += increment
+                if status in SETTLED_STATUSES:
+                    tb["settled"] += 1
+                    tb["useful_steps"] += done
         elif kind == "epoch":
             t["epoch"] = int(data.get("epoch", t["epoch"]))
             t["step"] = int(ev.get("step") or t["step"])
@@ -571,6 +596,26 @@ class SweepFold:
     @property
     def goodput(self) -> Optional[float]:
         return self.useful / self.executed if self.executed else None
+
+    def tenant_books(self) -> dict[str, dict]:
+        """JSON-shaped per-tenant rollup (trial sets become counts,
+        goodput derived) — {} on streams with no tenant tags."""
+        out = {}
+        for tenant in sorted(self.tenants):
+            b = self.tenants[tenant]
+            out[tenant] = {
+                "trials": len(b["trials"]),
+                "attempts": b["attempts"],
+                "settled": b["settled"],
+                "useful_steps": b["useful_steps"],
+                "executed_steps": b["executed_steps"],
+                "goodput": (
+                    round(b["useful_steps"] / b["executed_steps"], 4)
+                    if b["executed_steps"]
+                    else None
+                ),
+            }
+        return out
 
 
 def _attach_device_books(fold: SweepFold, registry) -> dict:
@@ -684,6 +729,11 @@ def run_summary(
     }
     if fold.pbt:
         out["pbt"] = fold.pbt
+    if fold.tenants:
+        # Per-tenant goodput (sweep-service streams whose ledger stamps
+        # tenant provenance on attempt records) — absent otherwise so
+        # pre-service summaries stay byte-identical.
+        out["tenants"] = fold.tenant_books()
     if registry is not None:
         out["metrics"] = registry.snapshot()
     return out

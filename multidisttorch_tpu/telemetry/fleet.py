@@ -439,6 +439,56 @@ def per_world_books(events: list[dict]) -> dict:
     return books
 
 
+def per_tenant_books(events: list[dict]) -> dict:
+    """Goodput fold per TENANT off tenant-tagged ``attempt_end``
+    events (the sweep service's ledger stamps tenant/priority/
+    submit_ts provenance — hpo/ledger.py). Same dedup and own-work
+    accounting as :func:`per_world_books`; empty on streams with no
+    tenant tags, so pre-service fleet summaries gain no key noise."""
+    books: dict = {}
+    seen: set = set()
+    for ev in events:
+        if ev.get("kind") != "attempt_end":
+            continue
+        data = ev.get("data") or {}
+        tenant = data.get("tenant")
+        if tenant is None:
+            continue
+        key = (ev.get("trial_id"), ev.get("attempt"), data.get("status"))
+        if key in seen:
+            continue
+        seen.add(key)
+        b = books.setdefault(
+            str(tenant),
+            {
+                "attempt_ends": 0,
+                "settled": 0,
+                "useful_steps": 0,
+                "executed_steps": 0,
+                "trials": set(),
+            },
+        )
+        b["attempt_ends"] += 1
+        if ev.get("trial_id") is not None:
+            b["trials"].add(int(ev["trial_id"]))
+        s = data.get("summary") or {}
+        done = int(s.get("steps", s.get("steps_at_failure", 0)) or 0)
+        resumed = int(s.get("resumed_from_step", 0) or 0)
+        work = max(0, done - resumed)
+        b["executed_steps"] += work
+        if data.get("status") in SETTLED_STATUSES:
+            b["settled"] += 1
+            b["useful_steps"] += work
+    for b in books.values():
+        b["trials"] = len(b["trials"])
+        b["goodput"] = (
+            round(b["useful_steps"] / b["executed_steps"], 4)
+            if b["executed_steps"]
+            else None
+        )
+    return books
+
+
 def restart_tax_report(events: list[dict]) -> list[dict]:
     """Per world transition, the wall cost of the restart, split into
     phases. The supervisor's ``restart_tax`` event (emitted the moment
@@ -728,6 +778,7 @@ def fleet_summary(
             rec["lease_ts_fleet"] = float(lease.get("ts", 0.0)) + off
 
     books = per_world_books(events)
+    tenant_books = per_tenant_books(events)
     useful = sum(b["useful_steps"] for b in books.values())
     executed = sum(b["executed_steps"] for b in books.values())
     tax = restart_tax_report(events)
@@ -754,6 +805,7 @@ def fleet_summary(
         "world_transitions": max(0, len(worlds) - 1),
         "world_shrunk_traced": kinds.get("world_shrunk", 0) > 0,
         "per_world": books,
+        "per_tenant": tenant_books,
         "useful_steps": useful,
         "executed_steps": executed,
         "goodput": round(useful / executed, 4) if executed else None,

@@ -22,6 +22,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from multidisttorch_tpu.telemetry.console import (  # noqa: E402
+    fmt_duration,
     fmt_table,
     fmt_ts,
     status_glyph,
@@ -122,6 +123,63 @@ def render(folded: dict[str, dict], path: str) -> str:
     return "\n".join(lines)
 
 
+def render_queue(folded: dict[str, dict], path: str) -> str:
+    """Service-queue panel: every submission's lifecycle state with
+    tenant, age, and shape bucket (docs/SERVICE.md)."""
+    import time
+
+    from multidisttorch_tpu.service.queue import QueueStats
+
+    now = time.time()
+    stats = QueueStats.of(folded)
+    lines = [f"service queue  {path}", ""]
+    lines.append(
+        "  ".join(
+            f"{state} {n}"
+            for state, n in sorted(stats.by_state.items())
+        )
+        or "empty"
+    )
+    lines.append("")
+    rows = []
+    order = {"placed": 0, "admitted": 1, "pending": 2, "settled": 3,
+             "rejected": 4}
+    for sid, rec in sorted(
+        folded.items(),
+        key=lambda kv: (
+            order.get(kv[1]["state"], 9), kv[1].get("submit_ts") or 0.0
+        ),
+    ):
+        age = (
+            fmt_duration(now - rec["submit_ts"])
+            if rec.get("submit_ts")
+            else "-"
+        )
+        rows.append(
+            [
+                sid[:24],
+                rec.get("tenant", "?"),
+                rec.get("priority", "-"),
+                rec["state"],
+                rec.get("trial_id") if rec.get("trial_id") is not None
+                else "-",
+                rec.get("size", 1),
+                (rec.get("bucket") or "-")[:24],
+                age,
+                (rec.get("status") or "")[:12],
+                (rec.get("error") or "")[:32],
+            ]
+        )
+    lines.append(
+        fmt_table(
+            rows,
+            ["submission", "tenant", "pri", "state", "trial", "size",
+             "bucket", "age", "status", "error"],
+        )
+    )
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="human-readable sweep-ledger dump "
@@ -147,7 +205,39 @@ def main(argv=None) -> int:
         "restart folds (settled-skip, attempt numbering, retry "
         "budgets) are provably unchanged",
     )
+    parser.add_argument(
+        "--queue", action="store_true",
+        help="render the sweep SERVICE's submission queue instead of "
+        "the attempt ledger: pending/admitted/placed/settled "
+        "submissions with tenant, age, and shape bucket "
+        "(docs/SERVICE.md; reads {dir}/queue.jsonl)",
+    )
     args = parser.parse_args(argv)
+    if args.queue:
+        from multidisttorch_tpu.service.queue import (
+            fold_queue,
+            load_queue,
+            queue_path,
+        )
+
+        service_dir = (
+            args.path if os.path.isdir(args.path)
+            else os.path.dirname(args.path) or "."
+        )
+        qpath = queue_path(service_dir)
+        folded = fold_queue(load_queue(service_dir))
+        if args.json:
+            import json
+
+            print(json.dumps(
+                {"path": qpath, "by_submission": folded}, default=str
+            ))
+            return 0
+        if not folded:
+            print(f"no decodable queue records at {qpath}")
+            return 0 if os.path.exists(qpath) else 1
+        print(render_queue(folded, qpath))
+        return 0
     path = resolve_ledger_path(args.path)
     if not os.path.exists(path):
         print(f"no ledger at {path}", file=sys.stderr)

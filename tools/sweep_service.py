@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""The sweep service daemon CLI (docs/SERVICE.md).
+
+    python tools/sweep_service.py <service-dir> --slices 4 \
+        --tenant-weight alice=2 --tenant-weight bob=1 --retry 2
+
+Runs :class:`multidisttorch_tpu.service.runtime.SweepService` over the
+given directory until stopped. All state is durable under the
+directory, so a killed daemon (SIGKILL included) restarts with zero
+lost submissions — ``kill -9; restart`` is the CI drill, not a
+disaster.
+
+Signals follow ``run_hpo``'s drain contract (docs/RESILIENCE.md): the
+first SIGTERM/SIGINT drains — in-flight checkpoint writes land, live
+attempts are recorded ``preempted``, submissions are journaled
+``unplaced`` (they re-place on restart), books are written — and the
+process exits ``cluster.PREEMPTION_EXIT_CODE`` (75). A second signal
+kills immediately. Under ``tools/sweep_supervisor.py`` (launch with
+``--hosts 1 -- python tools/sweep_service.py …``) that exit code means
+"relaunch me": the supervisor re-forms the world and the daemon
+resumes from its journal — the service's elastic-restart story. With
+``MDT_HOST_SLOT`` set (the supervisor sets it) the daemon heartbeats a
+membership lease so a wedged daemon is detected without collectives.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _parse_kv(pairs, cast, what):
+    out = {}
+    for p in pairs or []:
+        if "=" not in p:
+            raise SystemExit(f"--{what} expects NAME=VALUE, got {p!r}")
+        k, v = p.split("=", 1)
+        out[k] = cast(v)
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="persistent multi-tenant sweep daemon"
+    )
+    parser.add_argument("service_dir")
+    parser.add_argument(
+        "--slices", type=int, default=None,
+        help="carve the device world into this many unit slices "
+        "(default: one slice per device)",
+    )
+    parser.add_argument("--max-lanes", type=int, default=4,
+                        help="stacked co-pack width per submesh")
+    parser.add_argument(
+        "--tenant-weight", action="append", metavar="NAME=W",
+        help="fair-share weight for a tenant (repeatable)",
+    )
+    parser.add_argument(
+        "--tenant-quota", action="append", metavar="NAME=N",
+        help="max pending submissions per tenant (repeatable)",
+    )
+    parser.add_argument("--max-total-pending", type=int, default=4096)
+    parser.add_argument("--data-rows", type=int, default=512,
+                        help="rows of the service's training dataset")
+    parser.add_argument("--starvation", type=float, default=3.0,
+                        help="seconds a blocked trial waits before "
+                        "defragmentation is considered")
+    parser.add_argument("--no-defrag", action="store_true")
+    parser.add_argument("--retry", type=int, default=2,
+                        help="infra retry budget per trial (0 disables)")
+    parser.add_argument("--precompile", action="store_true",
+                        help="warm admitted trials' executables on the "
+                        "AOT farm before placement (docs/COMPILE.md)")
+    parser.add_argument("--exit-when-drained", action="store_true",
+                        help="exit once queue+spool+submeshes are idle "
+                        "(CI/bench mode; default: keep serving)")
+    parser.add_argument("--idle-grace", type=float, default=1.0)
+    parser.add_argument("--max-wall", type=float, default=None)
+    parser.add_argument("--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    # Telemetry + membership BEFORE jax-heavy imports: the daemon's
+    # observability must exist even if backend init wedges.
+    from multidisttorch_tpu import telemetry
+    from multidisttorch_tpu.parallel import membership
+
+    if not telemetry.enabled():
+        telemetry.configure(os.path.join(args.service_dir, "telemetry"))
+    slot = os.environ.get("MDT_HOST_SLOT")
+    if slot is not None:
+        membership.start_heartbeat(
+            args.service_dir,
+            int(slot),
+            world_epoch=int(os.environ.get("MDT_WORLD_EPOCH", "0") or 0),
+        )
+
+    from multidisttorch_tpu.hpo.supervision import (
+        RetryPolicy,
+        exit_code_for,
+    )
+    from multidisttorch_tpu.parallel.cluster import PREEMPTION_EXIT_CODE
+    from multidisttorch_tpu.service.runtime import SweepService
+    from multidisttorch_tpu.service.scheduler import TenantPolicy
+
+    weights = _parse_kv(args.tenant_weight, float, "tenant-weight")
+    quotas = _parse_kv(args.tenant_quota, int, "tenant-quota")
+    policies = {
+        name: TenantPolicy(
+            weight=weights.get(name, 1.0),
+            max_pending=quotas.get(name, 256),
+        )
+        for name in set(weights) | set(quotas)
+    }
+    svc = SweepService(
+        args.service_dir,
+        n_slices=args.slices,
+        max_lanes=args.max_lanes,
+        policies=policies,
+        max_total_pending=args.max_total_pending,
+        data_rows=args.data_rows,
+        starvation_s=args.starvation,
+        defrag_enabled=not args.no_defrag,
+        retry=RetryPolicy(max_retries=args.retry) if args.retry else None,
+        verbose=args.verbose,
+        precompile=args.precompile,
+    )
+
+    def on_signal(signum, frame):
+        if svc._stop:  # second signal: the operator means it
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        svc.stop()
+
+    for s in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(s, on_signal)
+        except (ValueError, OSError):
+            pass
+
+    try:
+        report = svc.serve(
+            max_wall_s=args.max_wall,
+            exit_when_drained=args.exit_when_drained,
+            idle_grace_s=args.idle_grace,
+        )
+    except BaseException as e:  # noqa: BLE001 — exit-code contract
+        membership.stop_heartbeat()
+        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+            raise
+        print(
+            f"sweep service died: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return exit_code_for(e)
+    membership.stop_heartbeat()
+    print(json.dumps(
+        {k: report[k] for k in ("outcome", "wall_s")}
+        | {"settled": len(report["settled"])}
+    ))
+    if report["outcome"] == "preempted":
+        return PREEMPTION_EXIT_CODE
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -462,6 +462,178 @@ def follow_lines(path: str, state: SweepFold, offset: int) -> int:
     return offset + consumed
 
 
+class ServiceFollow:
+    """Incremental fold over a sweep SERVICE directory: the
+    submission-queue journal and the telemetry events are read from
+    persistent byte offsets across refreshes (complete lines only —
+    the daemon-side pattern), so a console left following a long-lived
+    daemon never re-parses its whole history per redraw. A file
+    shorter than its offset (a rewrite under us) resets that fold."""
+
+    def __init__(self, service_dir: str):
+        self.service_dir = service_dir
+        self.qfold: dict = {}
+        self.qoffset = 0
+        self.state = SweepFold()
+        self.eoffset = 0
+
+    def _guard_shrink(self, path: str, offset: int, reset) -> int:
+        try:
+            if os.path.getsize(path) < offset:
+                reset()
+                return 0
+        except OSError:
+            pass
+        return offset
+
+    def refresh(self):
+        from multidisttorch_tpu.service.queue import (
+            fold_queue_into,
+            queue_path,
+            read_jsonl_from,
+        )
+
+        qp = queue_path(self.service_dir)
+        self.qoffset = self._guard_shrink(
+            qp, self.qoffset, self.qfold.clear
+        )
+        recs, self.qoffset = read_jsonl_from(qp, self.qoffset)
+        fold_queue_into(self.qfold, recs)
+        books = {}
+        bpath = os.path.join(self.service_dir, "service_books.json")
+        try:
+            with open(bpath) as f:
+                books = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            pass
+        epath = os.path.join(self.service_dir, "telemetry", EVENTS_NAME)
+        if os.path.exists(epath):
+            def reset_state():
+                self.state = SweepFold()
+
+            self.eoffset = self._guard_shrink(
+                epath, self.eoffset, reset_state
+            )
+            self.eoffset = follow_lines(epath, self.state, self.eoffset)
+        return self.qfold, books, self.state
+
+
+def service_state(service_dir: str):
+    """One-shot fold of a sweep SERVICE directory (the follow loop
+    keeps a persistent :class:`ServiceFollow` instead)."""
+    return ServiceFollow(service_dir).refresh()
+
+
+def render_service(folded, books, state, service_dir: str) -> str:
+    """Tenant/queue panel over a service directory (docs/SERVICE.md):
+    queue depth by state, per-tenant goodput + fair-share vs weight,
+    scheduling-latency books, the fragmentation gauge and defrag
+    accounting, then the per-trial table of whatever telemetry shows."""
+    from multidisttorch_tpu.service.queue import QueueStats
+
+    now = time.time()
+    lines = [f"sweep service  {service_dir}", ""]
+    stats = QueueStats.of(folded)
+    lines.append(
+        "queue  "
+        + (
+            "  ".join(
+                f"{s} {n}" for s, n in sorted(stats.by_state.items())
+            )
+            or "empty"
+        )
+    )
+    frag = books.get("fragmentation") or {}
+    if frag:
+        lines.append(
+            f"slices free {frag.get('free_slices')}  largest run "
+            f"{frag.get('largest_free_run')}  fragmentation "
+            f"{frag.get('now')} (max {frag.get('max')})"
+        )
+    dfr = books.get("defrag") or {}
+    if dfr.get("events"):
+        lines.append(
+            f"defrag  events {dfr['events']}  moved slices "
+            f"{dfr.get('moved_slices')}  unblocked "
+            f"{len(dfr.get('unblocked') or [])}"
+        )
+    for label, key in (
+        ("queue-wait", "queue_wait"),
+        ("placement", "placement_latency"),
+    ):
+        h = books.get(key) or {}
+        if h.get("count"):
+            lines.append(
+                f"{label}  n {h['count']}  p50 "
+                f"{fmt_duration(h.get('p50_s'))}  p99 "
+                f"{fmt_duration(h.get('p99_s'))}  max "
+                f"{fmt_duration(h.get('max_s'))}"
+            )
+    lines.append("")
+    tenants = books.get("tenants") or {}
+    fair = books.get("fair_share") or {}
+    names = sorted(set(tenants) | set(fair) | set(stats.by_tenant))
+    if names:
+        rows = []
+        for t in names:
+            tb = tenants.get(t) or {}
+            fb = fair.get(t) or {}
+            by = stats.by_tenant.get(t) or {}
+            rows.append(
+                [
+                    t,
+                    fb.get("weight", "-"),
+                    by.get("pending", 0) + by.get("admitted", 0),
+                    by.get("placed", 0),
+                    by.get("settled", 0),
+                    tb.get("useful_steps", "-"),
+                    tb.get("goodput") if tb.get("goodput") is not None
+                    else "-",
+                    fb.get("contended_share") if
+                    fb.get("contended_share") is not None else "-",
+                    fb.get("ratio_to_weight") if
+                    fb.get("ratio_to_weight") is not None else "-",
+                ]
+            )
+        lines.append(
+            fmt_table(
+                rows,
+                ["tenant", "w", "queued", "run", "done", "useful",
+                 "goodput", "share", "share/w"],
+            )
+        )
+        lines.append("")
+    # Waiting/running submissions, oldest first.
+    live = [
+        r for r in folded.values()
+        if r["state"] in ("pending", "admitted", "placed")
+    ]
+    if live:
+        rows = []
+        for r in sorted(live, key=lambda r: r.get("submit_ts") or 0.0):
+            rows.append(
+                [
+                    r["submission_id"][:24],
+                    r.get("tenant", "?"),
+                    r.get("priority", "-"),
+                    r["state"],
+                    r.get("size", 1),
+                    fmt_duration(now - r["submit_ts"])
+                    if r.get("submit_ts") else "-",
+                ]
+            )
+        lines.append(
+            fmt_table(
+                rows,
+                ["submission", "tenant", "pri", "state", "size", "age"],
+            )
+        )
+        lines.append("")
+    if state.trials:
+        lines.append(render(state, service_dir))
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="live console over a sweep's telemetry event JSONL"
@@ -484,6 +656,14 @@ def main(argv=None) -> int:
         "migration lineage (docs/OBSERVABILITY.md \"Fleet\")",
     )
     parser.add_argument(
+        "--service", action="store_true",
+        help="tenant/queue view over a sweep SERVICE directory "
+        "(docs/SERVICE.md): submission-queue depth by state, per-tenant "
+        "goodput and fair-share vs weight, queue-wait/placement-latency "
+        "books, the fragmentation gauge and defrag accounting, plus the "
+        "usual per-trial table when telemetry is on",
+    )
+    parser.add_argument(
         "--deadline", type=float, default=3.0,
         help="heartbeat staleness (s) behind the fleet view's host "
         "health verdict — match the supervisor's --heartbeat-deadline",
@@ -503,6 +683,51 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.json and args.follow:
         parser.error("--json is one-shot; it cannot combine with --follow")
+
+    if args.service:
+        if not os.path.isdir(args.path):
+            print(f"--service expects a service directory, got {args.path}",
+                  file=sys.stderr)
+            return 1
+
+        def service_shot():
+            folded, books, state = service_state(args.path)
+            if args.json:
+                print(json.dumps(
+                    {
+                        "service_dir": args.path,
+                        "queue": folded,
+                        "books": books,
+                        "trials": {
+                            k: state.trials[k]
+                            for k in sorted(state.trials)
+                        },
+                    },
+                    default=str,
+                ))
+            else:
+                print(render_service(folded, books, state, args.path))
+
+        if not args.follow:
+            service_shot()
+            return 0
+        refreshes = 0
+        fol = ServiceFollow(args.path)
+        try:
+            while True:
+                folded, books, state = fol.refresh()
+                print(
+                    clear_screen()
+                    + render_service(folded, books, state, args.path),
+                    flush=True,
+                )
+                refreshes += 1
+                if args.max_refreshes and refreshes >= args.max_refreshes:
+                    break
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            pass
+        return 0
 
     if args.fleet:
         if not os.path.isdir(args.path):
