@@ -473,6 +473,206 @@ def bench_stacked() -> dict:
     return out
 
 
+DATAPLANE_LANES = 8
+DATAPLANE_ROWS = 2048   # per lane-dataset; 16 batches/round at BATCH=128
+DATAPLANE_ROUNDS = 4    # measured lockstep rounds per mode
+
+
+def bench_dataplane() -> dict:
+    """The production data plane's banked evidence (docs/DATA.md):
+    K=8 heterogeneous lanes — eight DISTINCT datasets through one
+    vmapped dispatch — comparing the pipelined sharded input path
+    against the synchronous reference on three axes:
+
+    - **bit-parity**: the fused heterogeneous dispatch's final params,
+      lane by lane, against each lane's classic ``make_train_step`` run
+      on its own dataset (the PR 1 parity recipe, now across dataset
+      boundaries), and pipelined vs synchronous feeds byte-for-byte;
+    - **input_bound_frac**: fraction of dispatch wall spent blocked on
+      the host gather+transfer, pipeline ON vs OFF — the "gather is off
+      the critical path" gate (< 5% with the pipeline);
+    - **packing across datasets**: the service scheduler co-packs 8
+      tenants with 8 different dataset refs of one shape class into ONE
+      placement (no per-dataset bucket splitting).
+    """
+    from multidisttorch_tpu.data.datasets import synthetic_mnist
+    from multidisttorch_tpu.data.sampler import StackedTrialDataIterator
+    from multidisttorch_tpu.models.vae import VAE
+    from multidisttorch_tpu.parallel.mesh import setup_groups
+    from multidisttorch_tpu.train.steps import (
+        TrialHypers,
+        create_stacked_train_state,
+        create_train_state,
+        make_stacked_train_step,
+        make_train_step,
+    )
+
+    K, rows, rounds = DATAPLANE_LANES, DATAPLANE_ROWS, DATAPLANE_ROUNDS
+    g = setup_groups(1)[0]
+    model = VAE(hidden_dim=HIDDEN, latent_dim=LATENT)
+    datasets = [synthetic_mnist(rows, seed=100 + k) for k in range(K)]
+    seeds = list(range(K))
+    lrs = [1e-3 * (1 + 0.1 * k) for k in range(K)]
+    hypers = TrialHypers.stack(lrs, [1.0] * K)
+    base_rngs = jnp.stack([jax.random.key(s + 1) for s in seeds])
+    sstep = make_stacked_train_step(g, model)
+    steps_per_round = rows // BATCH
+
+    def run_mode(prefetch: bool) -> dict:
+        state = create_stacked_train_state(g, model, seeds)
+        waits = {"wait_s": 0.0, "bytes": 0}
+
+        def wait_hook(dt, nb):
+            waits["wait_s"] += dt
+            waits["bytes"] += nb
+
+        it = StackedTrialDataIterator(
+            datasets[0], g, BATCH, seeds, datasets=datasets,
+            use_native=False, prefetch=prefetch, wait_hook=wait_hook,
+        )
+        # warmup compile outside the timed window — on a throwaway
+        # state (the stacked step donates its input state buffers)
+        warm_state = create_stacked_train_state(g, model, seeds)
+        warm = jnp.zeros((K, BATCH, 784), jnp.float32)
+        w, _ = sstep(
+            warm_state, hypers, warm, base_rngs, jnp.zeros((K,), jnp.int32)
+        )
+        jax.block_until_ready(w.params)
+        del warm_state, w
+        step_no = 0
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            for batch in it.round_batches():
+                state, _ = sstep(
+                    state, hypers, batch, base_rngs,
+                    jnp.full((K,), step_no, jnp.int32),
+                )
+                step_no += 1
+        jax.block_until_ready(state.params)
+        wall = time.perf_counter() - t0
+        return {
+            "wall_s": round(wall, 4),
+            "wait_s": round(waits["wait_s"], 4),
+            "bytes": waits["bytes"],
+            "input_bound_frac": round(waits["wait_s"] / wall, 4),
+            "bytes_per_s": round(waits["bytes"] / wall, 1),
+            "steps": step_no,
+            "state": state,
+        }
+
+    sync = run_mode(False)
+    pipe = run_mode(True)
+    pipeline_parity = bool(
+        jax.tree_util.tree_all(
+            jax.tree.map(
+                lambda a, b: bool(jnp.all(a == b)),
+                sync["state"].params,
+                pipe["state"].params,
+            )
+        )
+    )
+
+    # Per-lane classic reference across dataset boundaries: lane k's
+    # final params must be bit-identical to make_train_step fed by a
+    # TrialDataIterator-equivalent stream over ITS dataset.
+    from multidisttorch_tpu.data.sampler import epoch_permutation
+
+    lane_parity = True
+    for k in range(K):
+        su = create_train_state(
+            g, model, optax.adam(lrs[k]), jax.random.key(seeds[k])
+        )
+        ustep = make_train_step(g, model, optax.adam(lrs[k]), beta=1.0)
+        step_no = 0
+        for epoch in range(1, rounds + 1):
+            perm = epoch_permutation(
+                seeds[k], epoch, np.arange(rows)
+            )
+            for b in range(steps_per_round):
+                idx = perm[b * BATCH : (b + 1) * BATCH]
+                batch = jax.device_put(
+                    datasets[k].images[idx], g.batch_sharding
+                )
+                su, _ = ustep(
+                    su, batch,
+                    jax.random.fold_in(
+                        jax.random.key(seeds[k] + 1), step_no
+                    ),
+                )
+                step_no += 1
+        lane_params = jax.tree.map(
+            lambda x, k=k: x[k], pipe["state"].params
+        )
+        same = jax.tree_util.tree_all(
+            jax.tree.map(
+                lambda a, b: bool(jnp.all(a == b)), lane_params, su.params
+            )
+        )
+        lane_parity = lane_parity and bool(same)
+
+    # Scheduler-level co-pack across dataset refs: pure logic, no jax.
+    from multidisttorch_tpu.service.scheduler import (
+        FairShareScheduler,
+        PendingTrial,
+        SlicePool,
+    )
+
+    sched = FairShareScheduler()
+    shape_bucket = (("shape",), (784, steps_per_round))
+    for k in range(K):
+        sched.push(
+            PendingTrial(
+                sub_id=f"s{k}",
+                tenant=f"tenant-{k}",
+                priority=1,
+                cfg=None,
+                bucket=shape_bucket,  # dataset identity NOT in the key
+                size=1,
+                cost=10.0,
+                submit_ts=0.0,
+                trial_id=k,
+            )
+        )
+    placements = sched.schedule(SlicePool(2), max_lanes=K)
+    copack = (
+        len(placements) == 1 and placements[0].lanes == K
+    )
+
+    for mode in (sync, pipe):
+        mode.pop("state")
+    out = {
+        "lanes": K,
+        "rows_per_dataset": rows,
+        "batch": BATCH,
+        "rounds": rounds,
+        "distinct_datasets": K,
+        "prefetch_depth": int(
+            os.environ.get("MDT_STACKED_PREFETCH_DEPTH", "2")
+        ),
+        "synchronous": sync,
+        "pipelined": pipe,
+        "wall_ratio_sync_over_pipelined": round(
+            sync["wall_s"] / pipe["wall_s"], 3
+        ),
+        "bytes_per_s_per_host": pipe["bytes_per_s"],
+        "gates": {
+            "fused_bitwise_vs_per_lane_reference": lane_parity,
+            "pipeline_bitwise_vs_synchronous": pipeline_parity,
+            "input_bound_frac_pipelined_lt_5pct": (
+                pipe["input_bound_frac"] < 0.05
+            ),
+            "copack_across_datasets_single_placement": copack,
+        },
+    }
+    if jax.default_backend() == "cpu":
+        out["cpu_caveat"] = (
+            "virtual CPU devices share host cores with the gather "
+            "threads: input_bound_frac proves the overlap methodology; "
+            "absolute bytes/sec is not a TPU-host number"
+        )
+    return out
+
+
 TELEMETRY_AB_PASSES = 6  # alternating OFF/ON timed passes (3 each)
 
 
@@ -1681,6 +1881,16 @@ def main():
         "large-shape trial (banks artifacts/bench_service_*.json)",
     )
     parser.add_argument(
+        "--dataplane", action="store_true",
+        help="measure the per-tenant data plane (docs/DATA.md): K=8 "
+        "heterogeneous lanes (8 distinct datasets, one vmapped "
+        "dispatch) with the pipelined sharded input path vs the "
+        "synchronous reference — bytes/sec per host, input_bound_frac "
+        "< 5% gate, fused-vs-per-lane bit parity, and co-packing "
+        "across dataset boundaries (banks "
+        "artifacts/bench_dataplane_*.json)",
+    )
+    parser.add_argument(
         "--suite", action="store_true",
         help="bank every measurement (flagship, fused-loss comparison, "
         "LM, to-elbo, loader) in one process — for one-shot windows on "
@@ -1692,13 +1902,13 @@ def main():
            for x in (args.concurrency, args.to_elbo, args.loader,
                      args.lm, args.suite, args.decode, args.stacked,
                      args.chaos, args.chaos_mh, args.coldstart,
-                     args.pbt, args.service)) > 1:
+                     args.pbt, args.service, args.dataplane)) > 1:
         parser.error("--concurrency/--to-elbo/--loader/--lm/--decode/"
                      "--suite/--stacked/--chaos/--chaos-mh/--coldstart/"
-                     "--pbt/--service are mutually exclusive")
+                     "--pbt/--service/--dataplane are mutually exclusive")
 
     if (args.stacked or args.chaos or args.chaos_mh or args.pbt
-            or args.service) and \
+            or args.service or args.dataplane) and \
             "xla_force_host_platform_device_count" not in (
         os.environ.get("XLA_FLAGS", "")
     ):
@@ -1995,6 +2205,50 @@ def main():
                     "fleet_summary": fleet["banked_paths"].get(
                         "summary", fleet["paths"].get("summary")
                     ),
+                    "detail": r,
+                }
+            )
+        )
+        return
+
+    if args.dataplane:
+        r = bench_dataplane()
+        r["backend"] = backend
+        banked = None
+        try:
+            os.makedirs("artifacts", exist_ok=True)
+            stamp = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
+            platform = backend.get("platform", "cpu")
+            banked = f"artifacts/bench_dataplane_{platform}_{stamp}.json"
+            tmp = banked + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(r, f, indent=1)
+            os.replace(tmp, banked)
+            latest = "artifacts/bench_dataplane_latest.json"
+            with open(latest + ".tmp", "w") as f:
+                json.dump({**r, "banked_as": banked}, f, indent=1)
+            os.replace(latest + ".tmp", latest)
+        except OSError as e:
+            print(f"artifact banking failed: {e!r}", file=sys.stderr)
+            banked = None
+        print(
+            json.dumps(
+                {
+                    "metric": "dataplane_host_to_device_bytes_per_s",
+                    "value": r["bytes_per_s_per_host"],
+                    "unit": "bytes/sec/host at K=8 heterogeneous lanes "
+                    "(pipelined)",
+                    # acceptance: fused dispatch bit-identical to the
+                    # per-lane reference, input_bound_frac < 5% with
+                    # the pipeline ON, co-packing across datasets
+                    # preserved; wall ratio recorded, not gated.
+                    "vs_baseline": r["wall_ratio_sync_over_pipelined"],
+                    "input_bound_frac": [
+                        r["synchronous"]["input_bound_frac"],
+                        r["pipelined"]["input_bound_frac"],
+                    ],
+                    "ok": all(r["gates"].values()),
+                    "banked_as": banked,
                     "detail": r,
                 }
             )

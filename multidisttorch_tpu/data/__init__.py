@@ -13,3 +13,10 @@ from multidisttorch_tpu.data.sampler import (
     StackedTrialDataIterator,
     TrialDataIterator,
 )
+from multidisttorch_tpu.data.store import (
+    DatasetStore,
+    parse_ref,
+    probe_ref,
+    register_provider,
+    resolve_dataset,
+)

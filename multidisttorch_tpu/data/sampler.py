@@ -22,7 +22,8 @@ from __future__ import annotations
 import os
 import queue as _queue
 import threading
-from typing import Callable, Iterator, Optional
+import time
+from typing import Callable, Iterator, Optional, Sequence
 
 import jax
 import numpy as np
@@ -32,22 +33,60 @@ from multidisttorch_tpu.parallel.mesh import TrialMesh
 
 
 def _prefetch_default() -> bool:
-    """The stacked host-gather prefetch's env kill switch: ON unless
-    ``MDT_STACKED_PREFETCH=0`` (docs/PBT.md bench protocol — the
-    off-path is the bit-parity reference and the fallback if a
-    platform's threading misbehaves)."""
+    """The stacked input pipeline's env kill switch: ON unless
+    ``MDT_STACKED_PREFETCH=0`` (docs/DATA.md pipeline tuning — the
+    off-path is the fully synchronous bit-parity reference and the
+    fallback if a platform's threading misbehaves)."""
     return os.environ.get("MDT_STACKED_PREFETCH", "1") != "0"
 
 
-def _prefetched(produce: Callable[[int], np.ndarray], n: int) -> Iterator:
-    """Double-buffer a host-side batch producer: a daemon worker runs
-    ``produce(b)`` for ``b`` in ``range(n)`` one gather AHEAD of the
-    consumer (1-slot queue + the in-flight item = two buffers), so the
-    next stacked gather overlaps the current device dispatch. Yields
+def _prefetch_depth() -> int:
+    """Pipeline depth (``MDT_STACKED_PREFETCH_DEPTH``, default 2):
+    how many produced items may sit ready ahead of the consumer —
+    queue slots; the in-flight ``produce`` call is one more buffer."""
+    try:
+        return max(1, int(os.environ.get("MDT_STACKED_PREFETCH_DEPTH", "2")))
+    except ValueError:
+        return 2
+
+
+_gather_pool_lock = threading.Lock()
+_gather_pool = None
+
+
+def _lane_gather_pool():
+    """Shared small thread pool for per-lane heterogeneous gathers
+    (``MDT_GATHER_THREADS``, default 4). Process-global: numpy fancy
+    indexing releases the GIL, so a handful of workers covers every
+    live iterator, and pool threads idle at zero cost between rounds."""
+    global _gather_pool
+    with _gather_pool_lock:
+        if _gather_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            try:
+                workers = max(
+                    1, int(os.environ.get("MDT_GATHER_THREADS", "4"))
+                )
+            except ValueError:
+                workers = 4
+            _gather_pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="mdt-lane-gather"
+            )
+        return _gather_pool
+
+
+def _prefetched(
+    produce: Callable[[int], object], n: int, depth: int = 1
+) -> Iterator:
+    """Pipeline a batch producer behind the consumer: a daemon worker
+    runs ``produce(b)`` for ``b`` in ``range(n)`` up to ``depth`` items
+    AHEAD (``depth``-slot queue + the in-flight item), so the next
+    gather/transfer overlaps the current device dispatch. Yields
     ``(b, item)`` in order; a producer exception re-raises at the
     consumer's ``next()``; abandoning the generator (consumer raise /
-    close) unblocks and retires the worker via the stop flag."""
-    q: _queue.Queue = _queue.Queue(maxsize=1)
+    close / GC) unblocks and retires the worker via the stop flag."""
+    q: _queue.Queue = _queue.Queue(maxsize=max(1, int(depth)))
     stop = threading.Event()
 
     def worker():
@@ -349,15 +388,28 @@ class StackedTrialDataIterator:
     iterator is regression-tested (tests/test_stacking.py).
 
     Lanes advance in lockstep rounds of ``num_batches`` steps (all lanes
-    share the dataset and batch size, so their epochs align to rounds);
-    :meth:`set_lane` rebinds a lane to a new seed mid-sweep — the data
-    half of mask-and-refill retirement (the refilled lane starts its own
-    epoch 1 while neighbors continue wherever they are).
+    share the batch size and the per-epoch batch count, so their epochs
+    align to rounds); :meth:`set_lane` rebinds a lane to a new seed —
+    and, with ``dataset=``, a new dataset — mid-sweep without
+    recompiling anything: the data half of mask-and-refill retirement
+    (the refilled lane starts its own epoch 1 while neighbors continue
+    wherever they are).
 
-    When the native C++ gatherer is available the interleaved round
-    permutation is handed to :class:`data.native.StackedBatchGatherer`,
-    so prefetch overlap carries over to stacked feeds; the numpy path is
-    bit-identical (same indices, same order).
+    **Heterogeneous lanes** (docs/DATA.md): ``datasets=[ds_0, ...,
+    ds_{K-1}]`` gives each lane its OWN dataset — K co-packed tenants
+    reading K different datasets through one vmapped dispatch. The
+    host gather becomes a per-lane indexed read into per-lane arrays
+    (parallelized over a small thread pool); every lane's dataset must
+    agree on feature dim and per-epoch batch count (the co-pack key's
+    batch-shape/round-length guarantee — enforced here too). When all
+    lanes share ONE dataset object the gather stays the single fused
+    fancy-index (bit-identical either way).
+
+    When the native C++ gatherer is available (homogeneous lanes only)
+    the interleaved round permutation is handed to
+    :class:`data.native.StackedBatchGatherer`, so prefetch overlap
+    carries over to stacked feeds; the numpy path is bit-identical
+    (same indices, same order).
     """
 
     def __init__(
@@ -367,9 +419,12 @@ class StackedTrialDataIterator:
         batch_size: int,
         seeds: list[int],
         *,
+        datasets: Optional[Sequence[Dataset]] = None,
         use_native: Optional[bool] = None,
         fault_hook: Optional[Callable] = None,
         prefetch: Optional[bool] = None,
+        prefetch_depth: Optional[int] = None,
+        wait_hook: Optional[Callable[[float, int], None]] = None,
     ):
         if batch_size % trial.data_size != 0:
             raise ValueError(
@@ -389,23 +444,47 @@ class StackedTrialDataIterator:
                 f"dataset of {len(dataset)} rows smaller than one batch "
                 f"of {batch_size}"
             )
-        # Per-lane stream state: (seed, epoch) fully determines a lane's
-        # permutation — identical seeding to TrialDataIterator, which is
-        # the whole parity contract.
-        self._lanes = [{"seed": s, "epoch": 1} for s in seeds]
+        if datasets is not None and len(datasets) != len(seeds):
+            raise ValueError(
+                f"datasets= names {len(datasets)} lanes but seeds= names "
+                f"{len(seeds)}"
+            )
+        # Input-stall accounting seam (telemetry/metrics.StepSeries
+        # ``wait_s`` book): called as wait_hook(blocked_s, nbytes) once
+        # per device-ready batch with the time the consumer spent
+        # blocked obtaining it. None (telemetry off) = no clock reads.
+        self.wait_hook = wait_hook
+        self._depth = (
+            _prefetch_depth() if prefetch_depth is None else
+            max(1, int(prefetch_depth))
+        )
+        # Per-lane stream state: (seed, epoch, dataset) fully determines
+        # a lane's permutation — identical seeding to TrialDataIterator,
+        # which is the whole parity contract.
+        self._lanes = [
+            {
+                "seed": s,
+                "epoch": 1,
+                "data": dataset if datasets is None else datasets[k],
+            }
+            for k, s in enumerate(seeds)
+        ]
+        for k, lane in enumerate(self._lanes):
+            self._check_lane_dataset(k, lane["data"])
         # Fault-injection seam: fault_hook(batch_index, stacked_np) ->
         # stacked_np runs on each assembled (K, B, ...) host array —
         # lane-targeted NaN poisoning for stacked divergence drills
         # (the vmapped program keeps lanes independent, so a poisoned
         # lane diverges alone). Must preserve shape/dtype.
         self.fault_hook = fault_hook
-        # Host-gather prefetch (numpy path only — the native gatherer
+        # Pipelined input (numpy path only — the native gatherer
         # already overlaps on its own C++ thread): the round's NEXT
-        # (K, B, ...) fancy-index gather runs on a background thread
-        # while the current batch's device transfer + dispatch are in
-        # flight. None → on unless the MDT_STACKED_PREFETCH=0 kill
-        # switch; bit-parity with the inline path is regression-tested
-        # (same permutations, same order — only the overlap differs).
+        # (K, B, ...) gathers AND (on the batch path) their device
+        # transfers run depth-N ahead on a background thread while the
+        # current dispatch is in flight. None → on unless the
+        # MDT_STACKED_PREFETCH=0 kill switch; bit-parity with the
+        # synchronous path is regression-tested (same permutations,
+        # same order, same placement — only the overlap differs).
         self._prefetch = (
             _prefetch_default() if prefetch is None else bool(prefetch)
         )
@@ -413,14 +492,59 @@ class StackedTrialDataIterator:
         if use_native is not False:
             from multidisttorch_tpu.data import native
 
+            if use_native and not self._homogeneous():
+                raise RuntimeError(
+                    "native fastloader gathers one shared images array; "
+                    "heterogeneous lane datasets use the numpy per-lane "
+                    "path (leave use_native unset)"
+                )
             if native.available():
                 self._use_native = True
             elif use_native:
                 raise RuntimeError("native fastloader unavailable")
 
-    def set_lane(self, k: int, seed: int, epoch: int = 1) -> None:
-        """Rebind lane ``k`` to a fresh (seed, epoch) stream (refill)."""
-        self._lanes[k] = {"seed": seed, "epoch": epoch}
+    def _check_lane_dataset(self, k: int, ds: Dataset) -> None:
+        """The heterogeneous-lane compatibility contract: every lane's
+        dataset must match the iterator's batch shape (feature dim) and
+        round length (batches per epoch) — exactly what the service's
+        co-pack key guarantees before two tenants share a bucket."""
+        dim0 = self.dataset.images.shape[1]
+        if ds.images.shape[1] != dim0:
+            raise ValueError(
+                f"lane {k} dataset {ds.name!r} has feature dim "
+                f"{ds.images.shape[1]} != {dim0} (stacked lanes must "
+                "agree on batch shape)"
+            )
+        nb = len(ds) // self.batch_size
+        if nb != self.num_batches:
+            raise ValueError(
+                f"lane {k} dataset {ds.name!r} yields {nb} batches per "
+                f"epoch != {self.num_batches} (lockstep rounds need "
+                "equal per-epoch batch counts; the co-pack key carries "
+                "this)"
+            )
+
+    def _homogeneous(self) -> bool:
+        """Whether every lane reads the SAME dataset object (the fused
+        single-gather / native-gatherer fast path)."""
+        first = self._lanes[0]["data"]
+        return all(lane["data"] is first for lane in self._lanes)
+
+    def set_lane(
+        self,
+        k: int,
+        seed: int,
+        epoch: int = 1,
+        dataset: Optional[Dataset] = None,
+    ) -> None:
+        """Rebind lane ``k`` to a fresh (seed, epoch) stream (refill),
+        optionally swapping in a new dataset — shapes are checked, and
+        nothing recompiles (the compiled program never sees which host
+        arrays fed it)."""
+        ds = self._lanes[k]["data"] if dataset is None else dataset
+        if dataset is not None:
+            self._check_lane_dataset(k, ds)
+        self._lanes[k] = {"seed": seed, "epoch": epoch, "data": ds}
 
     @property
     def samples_per_epoch(self) -> int:
@@ -428,16 +552,40 @@ class StackedTrialDataIterator:
         unstacked iterator)."""
         return self.num_batches * self.batch_size
 
-    def _round_perms(self) -> np.ndarray:
-        """(K, N) permutations for every lane's CURRENT epoch."""
-        return np.stack(
-            [
-                epoch_permutation(
-                    lane["seed"], lane["epoch"], np.arange(len(self.dataset))
-                )
-                for lane in self._lanes
+    def _round_perms(self) -> list[np.ndarray]:
+        """Per-lane permutations for every lane's CURRENT epoch (a
+        list — heterogeneous lanes' datasets may differ in row count
+        beyond the shared drop-tail round length)."""
+        return [
+            epoch_permutation(
+                lane["seed"], lane["epoch"], np.arange(len(lane["data"]))
+            )
+            for lane in self._lanes
+        ]
+
+    def _gather(self, perms: list[np.ndarray], b: int) -> np.ndarray:
+        """One (K, B, D) host gather for stacked step ``b``. Homogeneous
+        lanes keep the single fused fancy-index; heterogeneous lanes do
+        a per-lane indexed read into per-lane arrays, fanned over the
+        shared gather pool (bit-identical rows either way)."""
+        k, bs = self.num_lanes, self.batch_size
+        if self._homogeneous():
+            images = self._lanes[0]["data"].images
+            idx = np.stack(
+                [p[b * bs : (b + 1) * bs] for p in perms]
+            ).reshape(-1)
+            return images[idx].reshape(k, bs, -1)
+
+        def lane_rows(j: int) -> np.ndarray:
+            return self._lanes[j]["data"].images[
+                perms[j][b * bs : (b + 1) * bs]
             ]
-        )
+
+        if k >= 2:
+            parts = list(_lane_gather_pool().map(lane_rows, range(k)))
+        else:
+            parts = [lane_rows(0)]
+        return np.stack(parts)
 
     def _advance_epochs(self) -> None:
         for lane in self._lanes:
@@ -461,13 +609,13 @@ class StackedTrialDataIterator:
         """Yield ``num_batches`` host-side ``(K, B, D)`` arrays for one
         lockstep round, then advance every lane's epoch."""
         perms = self._round_perms()
-        k, bs = self.num_lanes, self.batch_size
-        if self._use_native:
+        bs = self.batch_size
+        if self._use_native and self._homogeneous():
             from multidisttorch_tpu.data.native import StackedBatchGatherer
 
-            g = StackedBatchGatherer(self.dataset.images)
+            g = StackedBatchGatherer(self._lanes[0]["data"].images)
             try:
-                n = g.start_round(perms, bs)
+                n = g.start_round(np.stack(perms), bs)
                 for b in range(n):
                     stacked = g.next_stacked()
                     if self.fault_hook is not None:
@@ -477,16 +625,17 @@ class StackedTrialDataIterator:
                 g.close()
         else:
             def produce(b: int) -> np.ndarray:
-                idx = perms[:, b * bs : (b + 1) * bs].reshape(-1)
-                return self.dataset.images[idx].reshape(k, bs, -1)
+                return self._gather(perms, b)
 
             if self._prefetch and self.num_batches > 1:
-                # Double-buffered gathers; the fault hook stays HERE on
-                # the consumer side so injected faults fire at the same
+                # Pipelined gathers; the fault hook stays HERE on the
+                # consumer side so injected faults fire at the same
                 # consumption point as the inline path (an injection
                 # raising one gather early would shift chaos-drill
                 # timelines).
-                for b, stacked in _prefetched(produce, self.num_batches):
+                for b, stacked in _prefetched(
+                    produce, self.num_batches, depth=self._depth
+                ):
                     if self.fault_hook is not None:
                         stacked = self.fault_hook(b, stacked)
                     yield stacked
@@ -498,27 +647,85 @@ class StackedTrialDataIterator:
                     yield stacked
         self._advance_epochs()
 
+    def _device_round(self) -> Iterator[tuple]:
+        """One lockstep round as ``(device_batch, nbytes)`` pairs — the
+        pipelined sharded input path (docs/DATA.md). When the pipeline
+        is eligible, the background worker runs the whole host gather
+        AND the ``device_put`` onto the submesh's NamedSharding (via
+        :meth:`_put`, which is already multi-host-aware), depth-N ahead
+        of the consumer, so the transfer overlaps the in-flight
+        dispatch too. The fault-hook and native paths keep their
+        transfer on the consumer side (chaos timing / the C++ thread
+        already overlaps the gather)."""
+        pipelined = (
+            self._prefetch
+            and self.fault_hook is None
+            and self.num_batches > 1
+            and not (self._use_native and self._homogeneous())
+        )
+        if not pipelined:
+            for stacked_np in self._host_round():
+                yield self._put(stacked_np), stacked_np.nbytes
+            return
+        perms = self._round_perms()
+
+        def produce(b: int) -> tuple:
+            arr = self._gather(perms, b)
+            return self._put(arr), arr.nbytes
+
+        for _b, item in _prefetched(
+            produce, self.num_batches, depth=self._depth
+        ):
+            yield item
+        self._advance_epochs()
+
+    def _timed(self, pairs: Iterator[tuple]) -> Iterator:
+        """Unwrap ``(item, nbytes)`` pairs, feeding the wait hook with
+        the interval the CONSUMER spent blocked obtaining each item —
+        the "dispatch blocked on gather" book. Timing only exists when
+        a hook is installed (zero-cost-when-off)."""
+        if self.wait_hook is None:
+            for item, _nb in pairs:
+                yield item
+            return
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item, nb = next(pairs)
+            except StopIteration:
+                return
+            self.wait_hook(time.perf_counter() - t0, nb)
+            yield item
+
     def round_batches(self) -> Iterator:
         """One lockstep round as per-step device-ready ``[K, B, ...]``
-        batches (the :func:`make_stacked_train_step` feed shape)."""
-        for stacked_np in self._host_round():
-            yield self._put(stacked_np)
+        batches (the :func:`make_stacked_train_step` feed shape),
+        pipelined per :meth:`_device_round`."""
+        return self._timed(self._device_round())
+
+    def _chunk_round(self, k_steps: int) -> Iterator[tuple]:
+        buf, start, nbytes = [], 0, 0
+        for i, stacked_np in enumerate(self._host_round()):
+            buf.append(stacked_np)
+            nbytes += stacked_np.nbytes
+            if len(buf) == k_steps:
+                yield (
+                    (start, self._put(np.stack(buf), extra_leading=2)),
+                    nbytes,
+                )
+                start, buf, nbytes = i + 1, [], 0
+        if buf:
+            yield (start, self._put(np.stack(buf), extra_leading=2)), nbytes
 
     def round_chunks(self, k_steps: int) -> Iterator:
         """One lockstep round as ``(start_batch_index, [S, K, B, ...])``
         chunks (the :func:`make_stacked_multi_step` feed shape), the
         final chunk possibly short — same tail contract as
-        :meth:`TrialDataIterator.epoch_chunks`."""
+        :meth:`TrialDataIterator.epoch_chunks`. Gathers are pipelined
+        (``_host_round``); chunk assembly + transfer stay consumer-side
+        and are charged to the wait book."""
         TrialDataIterator._check_chunk_size(k_steps)
-        buf, start = [], 0
-        for i, stacked_np in enumerate(self._host_round()):
-            buf.append(stacked_np)
-            if len(buf) == k_steps:
-                yield start, self._put(np.stack(buf), extra_leading=2)
-                start = i + 1
-                buf = []
-        if buf:
-            yield start, self._put(np.stack(buf), extra_leading=2)
+        return self._timed(self._chunk_round(k_steps))
 
     def stream_chunks(self, k_steps: int) -> Iterator:
         """Endless full ``[S, K, B, ...]`` chunks crossing round

@@ -135,6 +135,17 @@ class TrialConfig:
     # microbatches, accumulate grads in-step, one optimizer update —
     # the effective batch size can exceed HBM. Composes with remat.
     grad_accum: int = 1
+    # Per-trial dataset reference (docs/DATA.md): "" = the sweep's
+    # shared train_data (the pre-ref behavior, byte-compatible). A
+    # non-empty spec ("synthetic-mnist?rows=512&seed=3", "file:...",
+    # "cas:<sha256>") resolves through data/store.resolve_dataset — the
+    # service resolves it against its content-addressed cache at
+    # admission, run_hpo at sweep entry. It participates in the config
+    # hash and the resume config-match like any other hyperparameter
+    # (weights trained on one dataset must not silently resume under
+    # another). Trials with DIFFERENT datasets of the same shape class
+    # still co-pack into one stacked bucket (heterogeneous lanes).
+    dataset: str = ""
 
 
 @dataclass
@@ -1458,6 +1469,15 @@ def config_is_stackable(cfg: TrialConfig) -> bool:
     return not cfg.eval_sampled
 
 
+def data_shape_sig(ds: Dataset, batch_size: int) -> tuple:
+    """The dataset half of a co-pack decision: feature dim (batch-shape
+    agreement) and per-epoch batch count (lockstep-round agreement).
+    Deliberately NOT the dataset's identity — K lanes reading K
+    different datasets of one shape class share a bucket (docs/DATA.md
+    heterogeneous lanes)."""
+    return (int(ds.images.shape[1]), len(ds) // max(1, int(batch_size)))
+
+
 class _StackedBucketRun:
     """One shape-bucket of K stacked trials on ONE submesh, as a
     cooperative generator (the stacked sibling of :class:`_TrialRun`).
@@ -1496,6 +1516,7 @@ class _StackedBucketRun:
         attempts: Optional[dict] = None,  # config index -> attempts started
         chashes: Optional[dict] = None,  # config index -> config hash
         infra_fails: Optional[dict] = None,  # config index -> infra failures
+        datasets: Optional[dict] = None,  # config index -> Dataset
     ):
         template = items[0][1]
         for _, cfg in items:
@@ -1503,6 +1524,25 @@ class _StackedBucketRun:
                 raise ValueError(
                     "stacked bucket mixes shape keys: "
                     f"{stack_bucket_key(cfg)} vs {stack_bucket_key(template)}"
+                )
+        # Heterogeneous lanes (docs/DATA.md): a member with its own
+        # dataset reads it through its lane's slot of the one stacked
+        # gather; members without one read the bucket's shared data.
+        # Shape-class agreement (dim + per-epoch batches) is the
+        # co-pack contract callers already grouped by — re-checked here
+        # and by the iterator.
+        self._default_data = train_data
+        self._datasets = dict(datasets or {})
+        self._ref_data = self._datasets.get(items[0][0], train_data)
+        base_sig = data_shape_sig(self._ref_data, template.batch_size)
+        for idx, _cfg in items:
+            ds = self._datasets.get(idx, train_data)
+            sig = data_shape_sig(ds, template.batch_size)
+            if sig != base_sig:
+                raise ValueError(
+                    f"stacked bucket mixes dataset shape classes: "
+                    f"{sig} vs {base_sig} (member {idx}, dataset "
+                    f"{ds.name!r})"
                 )
         self.trial = trial
         self.out_dir = out_dir
@@ -1543,8 +1583,6 @@ class _StackedBucketRun:
         )
         self.fused = template.fused_steps
         self.batch_size = template.batch_size
-        self._train_name = train_data.name
-        self._train_synthetic = train_data.synthetic
 
         k = min(len(self.queue), max_lanes)
         first = [self.queue.pop(0) for _ in range(k)]
@@ -1554,12 +1592,35 @@ class _StackedBucketRun:
         ]
         for lane in self.lanes:
             self._note_attempt_start(lane)
+        # Input-stall seam (docs/DATA.md): the iterator reports each
+        # interval the dispatch loop sat blocked obtaining a batch.
+        # Wired only when telemetry is on (metrics registry feeds the
+        # StepSeries wait book; the bus gets a per-round input_wait
+        # event) — OFF constructs nothing and reads no clocks.
+        self._wait_counts = None
+        wait_hook = None
+        if self._mreg is not None or get_bus() is not None:
+            self._wait_counts = {"wait_s": 0.0, "bytes": 0}
+            series = (
+                self._mreg.step_series(self._mkey)
+                if self._mreg is not None
+                else None
+            )
+
+            def wait_hook(dt, nbytes, _series=series):
+                if _series is not None:
+                    _series.note_wait(dt, nbytes)
+                self._wait_counts["wait_s"] += dt
+                self._wait_counts["bytes"] += nbytes
+        self._input_t0 = time.time()
         self.data = StackedTrialDataIterator(
-            train_data, trial, self.batch_size,
+            self._ref_data, trial, self.batch_size,
             seeds=[lane["cfg"].seed for lane in self.lanes],
+            datasets=[lane["data"] for lane in self.lanes],
             fault_hook=(
                 None if injector is None else self._stacked_fault_hook
             ),
+            wait_hook=wait_hook,
         )
         self.test_iter = (
             EvalDataIterator(test_data, trial, self.batch_size)
@@ -1605,6 +1666,11 @@ class _StackedBucketRun:
                 )
             self._aot_template = template
 
+    def _data_of(self, idx: int) -> Dataset:
+        """The dataset config-index ``idx``'s lane reads (its own per-
+        submission dataset, else the bucket's shared default)."""
+        return self._datasets.get(idx, self._default_data)
+
     def _fresh_lane(self, idx: int, cfg: TrialConfig) -> dict:
         return {
             "idx": idx,
@@ -1614,6 +1680,7 @@ class _StackedBucketRun:
             "steps": 0,
             "t0": time.time(),
             "syncs0": self._host_syncs,
+            "data": self._data_of(idx),
         }
 
     def _refresh_lane_arrays(self) -> None:
@@ -1836,8 +1903,8 @@ class _StackedBucketRun:
                 out_dir=os.path.join(self.out_dir, f"trial-{cfg.trial_id}"),
                 status="failed",
                 error=error_text,
-                dataset=self._train_name,
-                dataset_synthetic=self._train_synthetic,
+                dataset=lane["data"].name,
+                dataset_synthetic=lane["data"].synthetic,
                 stacked=True,
                 attempt=self._attempts.get(idx, 1),
             )
@@ -1873,8 +1940,8 @@ class _StackedBucketRun:
             host_syncs=self._host_syncs - lane["syncs0"],
             status="diverged",
             error=str(err),
-            dataset=self._train_name,
-            dataset_synthetic=self._train_synthetic,
+            dataset=lane["data"].name,
+            dataset_synthetic=lane["data"].synthetic,
             stacked=True,
             attempt=self._attempts.get(idx, 1),
         )
@@ -1907,8 +1974,8 @@ class _StackedBucketRun:
             config=cfg,
             history=list(lane["history"]),
             out_dir=lane_out_dir,
-            dataset=self._train_name,
-            dataset_synthetic=self._train_synthetic,
+            dataset=lane["data"].name,
+            dataset_synthetic=lane["data"].synthetic,
             stacked=True,
         )
         last = lane["history"][-1]
@@ -1986,7 +2053,10 @@ class _StackedBucketRun:
                 self.trial.device_put(build_lane_state(self.model, nxt.seed)),
                 np.int32(k),
             )
-            self.data.set_lane(k, nxt.seed)
+            # The data half of the refill: the new occupant's stream —
+            # and, for a per-submission dataset, its own arrays — swap
+            # into lane k with zero recompiles.
+            self.data.set_lane(k, nxt.seed, dataset=self._data_of(idx))
             self._emit_lane("lane_refill", k, trial_id=nxt.trial_id)
             # Refill swaps a fresh lane state into the stacked tree —
             # a watermark moment (old + new lane buffers both live).
@@ -2161,6 +2231,20 @@ class _StackedBucketRun:
                     self._mkey, self.trial.devices, where="round",
                     group_id=self.trial.group_id,
                 )
+            # Input-stall books ride it too: one cumulative input_wait
+            # event per round (docs/DATA.md) — the console/summary
+            # mirror of the registry's StepSeries wait book.
+            if self._wait_counts is not None:
+                bus = get_bus()
+                if bus is not None:
+                    bus.emit(
+                        "input_wait",
+                        group_id=self.trial.group_id,
+                        key=self._mkey,
+                        wait_s=round(self._wait_counts["wait_s"], 6),
+                        bytes=self._wait_counts["bytes"],
+                        wall_s=round(time.time() - self._input_t0, 6),
+                    )
 
             test_sums = None
             if self.test_iter is not None:
@@ -2502,6 +2586,30 @@ def _run_hpo_body(
     from multidisttorch_tpu import telemetry as _telemetry
 
     _telemetry.configure_from_env()
+    # Per-trial dataset references (docs/DATA.md): resolve every
+    # distinct cfg.dataset ONCE at sweep entry (resolve_dataset's
+    # process memo makes twin specs share one host array, preserving
+    # the stacked gather's fused fast path). Resolution is
+    # deterministic, so multi-controller processes agree without
+    # communicating — but shard_across_trials partitions ONE shared
+    # dataset across trials, which a per-trial dataset contradicts.
+    data_by_idx: dict[int, Dataset] = {}
+    if any(getattr(cfg, "dataset", "") for cfg in configs):
+        if shard_across_trials:
+            raise ValueError(
+                "per-trial cfg.dataset is incompatible with "
+                "shard_across_trials (trial-sharding partitions the one "
+                "shared dataset)"
+            )
+        from multidisttorch_tpu.data.store import resolve_dataset
+
+        for i, cfg in enumerate(configs):
+            if getattr(cfg, "dataset", ""):
+                data_by_idx[i] = resolve_dataset(cfg.dataset)
+
+    def data_of(i: int) -> Dataset:
+        return data_by_idx.get(i, train_data)
+
     if groups is None:
         groups = setup_groups(
             num_groups if num_groups is not None else len(configs),
@@ -2631,12 +2739,13 @@ def _run_hpo_body(
             )
 
     def make_run(
-        trial: TrialMesh, cfg: TrialConfig, resume_mode, attempt: int = 1
+        trial: TrialMesh, i: int, cfg: TrialConfig, resume_mode,
+        attempt: int = 1,
     ) -> _TrialRun:
         return _TrialRun(
             trial,
             cfg,
-            train_data,
+            data_of(i),
             test_data,
             out_dir,
             shard_across_trials=shard_across_trials,
@@ -2715,7 +2824,15 @@ def _run_hpo_body(
         singles: list = []
         for item in indexed:
             if config_is_stackable(item[1]):
-                buckets.setdefault(stack_bucket_key(item[1]), []).append(item)
+                # Co-pack key = shape bucket + dataset SHAPE CLASS
+                # (dim, batches/epoch) — never dataset identity, so
+                # trials reading different datasets still share one
+                # vmapped program (heterogeneous lanes).
+                key = (
+                    stack_bucket_key(item[1]),
+                    data_shape_sig(data_of(item[0]), item[1].batch_size),
+                )
+                buckets.setdefault(key, []).append(item)
             else:
                 singles.append(item)
         items = []
@@ -2802,7 +2919,10 @@ def _run_hpo_body(
     per_group: dict[int, list] = {g.group_id: [] for g in groups}
     if not single:
         assignment = balanced_assignment(
-            [predicted_cost(cfg, len(train_data)) for cfg in configs],
+            [
+                predicted_cost(cfg, len(data_of(i)))
+                for i, cfg in enumerate(configs)
+            ],
             len(groups),
         )
         for i, cfg in enumerate(configs):
@@ -2943,6 +3063,11 @@ def _run_hpo_body(
                         attempts=attempts,
                         chashes=chashes,
                         infra_fails=infra_fails,
+                        datasets={
+                            i: data_by_idx[i]
+                            for i, _ in members
+                            if i in data_by_idx
+                        },
                     )
                 except Exception as e:  # noqa: BLE001 — setup isolation
                     error_text = f"{type(e).__name__}: {e}"
@@ -3008,7 +3133,7 @@ def _run_hpo_body(
             err: Optional[BaseException] = None
             run: Optional[_TrialRun] = None
             try:
-                run = make_run(g, cfg, resume_mode, attempt=attempts[i])
+                run = make_run(g, i, cfg, resume_mode, attempt=attempts[i])
             except Exception as e:  # noqa: BLE001 — setup failure isolation
                 err = e
             if needs_agreement(g):

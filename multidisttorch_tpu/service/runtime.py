@@ -247,6 +247,8 @@ class SweepService:
         train_data=None,
         test_data=None,
         data_rows: int = 512,
+        dataset_cache_bytes: Optional[int] = None,
+        dataset_ram_entries: int = 8,
         starvation_s: float = 3.0,
         defrag_enabled: bool = True,
         defrag_cooldown_s: float = 1.0,
@@ -288,6 +290,17 @@ class SweepService:
             else synthetic_mnist(data_rows, seed=0)
         )
         self.test_data = test_data
+        # Per-submission datasets (docs/DATA.md): content-addressed
+        # host-side cache + background prefetch, so a tenant's
+        # cfg.dataset resolves at ADMISSION off the daemon loop and
+        # placement only ever takes a RAM-warm dataset.
+        from multidisttorch_tpu.data.store import DatasetStore
+
+        self.store = DatasetStore(
+            os.path.join(service_dir, "dataset_cache"),
+            byte_budget=dataset_cache_bytes,
+            ram_entries=dataset_ram_entries,
+        )
         self.starvation_s = float(starvation_s)
         self.defrag_enabled = bool(defrag_enabled)
         self.defrag_cooldown_s = float(defrag_cooldown_s)
@@ -411,18 +424,30 @@ class SweepService:
                 continue
             # admitted or placed: the trial id and hash are already
             # assigned — rebuild the pending entry verbatim.
-            entry = self._entry_for(
-                sub,
-                trial_id=int(tid),
-                resume_scan=rec.get("placements", 0) > 0,
-            )
+            reject_reason = "recovered submission no longer parses"
+            try:
+                entry = self._entry_for(
+                    sub,
+                    trial_id=int(tid),
+                    resume_scan=rec.get("placements", 0) > 0,
+                )
+            except Exception as e:  # noqa: BLE001 — dataset ref went bad
+                entry = None
+                reject_reason = (
+                    "recovered submission's dataset reference failed "
+                    f"to probe: {type(e).__name__}: {e} (resubmit when "
+                    "the source is reachable)"
+                )
             if entry is None:
                 # Config no longer valid against today's TrialConfig
-                # (version skew): reject rather than crash the daemon.
+                # (version skew), or its dataset ref no longer probes:
+                # reject with the real reason rather than crash the
+                # daemon (explicit-verdict contract — the client
+                # resubmits; recovery does not retry probes).
                 self.queue.rejected(
                     sid,
                     verdict=REJECT_INVALID,
-                    reason="recovered submission no longer parses",
+                    reason=reject_reason,
                 )
                 self.settled[sid] = REJECT_INVALID
                 continue
@@ -449,6 +474,7 @@ class SweepService:
                     reason="daemon restart recovery",
                 )
             self.sched.push(entry, front=entry.resume_scan)
+            self._prefetch_data(entry)
             recovered += 1
         if recovered:
             log0(
@@ -486,17 +512,43 @@ class SweepService:
         trial_id: int,
         resume_scan: bool = False,
     ) -> Optional[PendingTrial]:
+        from multidisttorch_tpu.data.store import probe_ref
         from multidisttorch_tpu.hpo.driver import (
             config_is_stackable,
+            data_shape_sig,
             predicted_cost,
             stack_bucket_key,
         )
+        from multidisttorch_tpu.models.vae import VAE
 
         cfg = self._config_from(sub, trial_id)
         if cfg is None or sub.size > self.n_slices:
             return None
+        # Per-submission dataset: a cheap shape PROBE at admission
+        # (builtin = analytic, file = npz header, cas = store meta) —
+        # never a load. The probe feeds the co-pack key's shape class
+        # and the DRR cost; the bytes load in the background
+        # (_admit → store.prefetch). ValueError = rejected_invalid.
+        spec = getattr(cfg, "dataset", "") or ""
+        if spec:
+            dim, rows = probe_ref(spec, store=self.store)  # may raise
+            if dim != VAE.input_dim:
+                raise ValueError(
+                    f"dataset {spec!r} has feature dim {dim}; the "
+                    f"service's trial family trains on dim "
+                    f"{VAE.input_dim}"
+                )
+            if rows // cfg.batch_size < 1:
+                raise ValueError(
+                    f"dataset {spec!r} has {rows} rows < one batch of "
+                    f"{cfg.batch_size}"
+                )
+            dsig = (dim, rows // cfg.batch_size)
+        else:
+            rows = len(self.train_data)
+            dsig = data_shape_sig(self.train_data, cfg.batch_size)
         bucket = (
-            stack_bucket_key(cfg)
+            (stack_bucket_key(cfg), dsig)
             if config_is_stackable(cfg)
             else ("unstackable", trial_id)
         )
@@ -507,11 +559,10 @@ class SweepService:
             cfg=cfg,
             bucket=bucket,
             size=sub.size,
-            cost=float(
-                predicted_cost(cfg, len(self.train_data)) * sub.size
-            ),
+            cost=float(predicted_cost(cfg, rows) * sub.size),
             submit_ts=sub.submit_ts,
             trial_id=trial_id,
+            data_sig=dsig,
             resume_scan=resume_scan,
         )
 
@@ -519,8 +570,16 @@ class SweepService:
         verdict, reason = self.sched.admit_verdict(sub.tenant)
         if verdict == ADMIT:
             tid = self.next_trial_id
-            entry = self._entry_for(sub, trial_id=tid)
-            if entry is None:
+            try:
+                entry = self._entry_for(sub, trial_id=tid)
+            except Exception as e:  # noqa: BLE001 — bad dataset ref
+                entry = None
+                verdict, reason = (
+                    REJECT_INVALID,
+                    f"dataset reference rejected: "
+                    f"{type(e).__name__}: {e}",
+                )
+            if entry is None and verdict == ADMIT:
                 verdict, reason = (
                     REJECT_INVALID,
                     "config does not parse as a TrialConfig (unknown "
@@ -567,7 +626,49 @@ class SweepService:
             size=sub.size,
             bucket=str(entry.bucket),
         )
+        self._prefetch_data(entry)
         self._warm(entry)
+
+    # -- per-submission datasets -------------------------------------
+
+    @staticmethod
+    def _data_spec(entry: PendingTrial) -> str:
+        return getattr(entry.cfg, "dataset", "") or ""
+
+    def _prefetch_data(self, entry: PendingTrial) -> None:
+        """Admission-time background dataset warm (the farm pattern):
+        queue the load now so placement takes a RAM-warm dataset."""
+        spec = self._data_spec(entry)
+        if spec:
+            self.store.prefetch(spec)
+
+    def _take_dataset(self, spec: str):
+        """Placement-time dataset read: a RAM/disk-warm ``get``, except
+        a FAILED prefetch surfaces its RECORDED exception (and clears
+        the job so the retry path re-prefetches in the background) —
+        the daemon loop never re-runs a failed load inline."""
+        err = self.store.prefetch_error(spec)
+        if err is not None:
+            self.store.clear_job(spec)
+            raise err
+        return self.store.get(spec)
+
+    def _data_ready(self, entry: PendingTrial) -> bool:
+        """Scheduler veto: an entry whose dataset is still LOADING is
+        skipped WITHOUT consuming its fair-share turn (placement never
+        blocks on a dataset load). A FAILED load lets placement proceed
+        and fail through the normal setup-retry path, which carries the
+        real exception and the retry budget."""
+        from multidisttorch_tpu.data import store as dstore
+
+        spec = self._data_spec(entry)
+        if not spec:
+            return True
+        state = self.store.state(spec)
+        if state == dstore.UNKNOWN:
+            self.store.prefetch(spec)
+            return False
+        return state != dstore.LOADING
 
     def _warm(self, entry: PendingTrial) -> None:
         """Admission-time executable warming (PR 7): submit the trial's
@@ -605,12 +706,63 @@ class SweepService:
         t0 = time.perf_counter()
         now = time.time()
         mesh = self._mesh_for(p.start, p.size)
-        stacked = len(p.members) >= 2
+        # Per-submission datasets resolve FIRST, member by member — a
+        # RAM/disk-warm read when the admission-time prefetch landed.
+        # A member whose dataset fails (file gone, cas entry evicted,
+        # recorded prefetch error) fails ALONE through the setup-retry
+        # machinery: its co-packed neighbors keep the placement — one
+        # tenant's bad dataset must not fail or burn the retry budget
+        # of every tenant sharing the bucket.
+        from multidisttorch_tpu.hpo.driver import data_shape_sig
+
+        members = list(p.members)
+        datasets = {}
+        # One resolution per SPEC (members may share one): a failed
+        # spec's recorded error is raised once and reused — clearing
+        # its job per member would let the second member fall through
+        # to a fresh inline load on the daemon loop.
+        resolved: dict[str, object] = {}
+        for e in list(members):
+            spec = self._data_spec(e)
+            if not spec:
+                continue
+            if spec not in resolved:
+                try:
+                    resolved[spec] = self._take_dataset(spec)
+                except Exception as exc:  # noqa: BLE001
+                    resolved[spec] = exc
+            out = resolved[spec]
+            if isinstance(out, BaseException):
+                members.remove(e)
+                self._setup_failed([e], out)
+                continue
+            # Shape-class drift guard: a file replaced between the
+            # admission probe and placement resolves to DIFFERENT
+            # shapes than the bucket was packed under — without this,
+            # _StackedBucketRun's own check would raise and fail every
+            # co-packed neighbor.
+            got = data_shape_sig(out, e.cfg.batch_size)
+            if e.data_sig is not None and got != e.data_sig:
+                members.remove(e)
+                self._setup_failed(
+                    [e],
+                    ValueError(
+                        f"dataset {spec!r} changed shape class since "
+                        f"admission: probed {e.data_sig}, resolved "
+                        f"{got} — resubmit under the new content"
+                    ),
+                )
+                continue
+            datasets[e.trial_id] = out
+        if not members:
+            self.pool.free(p.start, p.size)
+            return
+        stacked = len(members) >= 2
         try:
             if stacked:
                 run = _StackedBucketRun(
                     mesh,
-                    [(e.trial_id, e.cfg) for e in p.members],
+                    [(e.trial_id, e.cfg) for e in members],
                     self.train_data,
                     self.test_data,
                     self.service_dir,
@@ -622,9 +774,10 @@ class SweepService:
                     attempts=self.attempts,
                     chashes=self.chashes,
                     infra_fails=self.infra_fails,
+                    datasets=datasets,
                 )
             else:
-                e = p.members[0]
+                e = members[0]
                 self.attempts[e.trial_id] = (
                     self.attempts.get(e.trial_id, 0) + 1
                 )
@@ -636,7 +789,7 @@ class SweepService:
                 run = _TrialRun(
                     mesh,
                     e.cfg,
-                    self.train_data,
+                    datasets.get(e.trial_id, self.train_data),
                     self.test_data,
                     self.service_dir,
                     save_images=False,
@@ -648,7 +801,7 @@ class SweepService:
                 )
         except Exception as exc:  # noqa: BLE001 — setup isolation
             self.pool.free(p.start, p.size)
-            self._setup_failed(p, exc)
+            self._setup_failed(members, exc)
             return
         ap = _Active(
             placement_id=p.placement_id,
@@ -657,13 +810,13 @@ class SweepService:
             stacked=stacked,
             run=run,
             gen=run.run(),
-            entries={e.trial_id: e for e in p.members},
+            entries={e.trial_id: e for e in members},
             place_ts=now,
             construct_s=time.perf_counter() - t0,
-            tenants=tuple(sorted({e.tenant for e in p.members})),
+            tenants=tuple(sorted({e.tenant for e in members})),
         )
         self.active[p.placement_id] = ap
-        for e in p.members:
+        for e in members:
             if e.sub_id in self._defrag_targets:
                 # The defrag verdict lands only now: the starved trial
                 # actually got a submesh.
@@ -675,7 +828,7 @@ class SweepService:
                 trial_id=e.trial_id,
                 start=p.start,
                 size=p.size,
-                lanes=len(p.members),
+                lanes=len(members),
                 stacked=stacked,
                 resumed=e.resume_scan,
             )
@@ -687,23 +840,24 @@ class SweepService:
                 tenant=e.tenant,
                 start=p.start,
                 size=p.size,
-                lanes=len(p.members),
+                lanes=len(members),
                 stacked=stacked,
                 queue_wait_s=round(max(0.0, now - e.submit_ts), 4),
             )
 
-    def _setup_failed(self, p: Placement, exc: BaseException) -> None:
-        """Placement construction failed before any lane existed:
-        retry each member within the infra budget (as a classic run —
+    def _setup_failed(self, members, exc: BaseException) -> None:
+        """Setup failed before any lane existed for these members
+        (placement construction, or one member's dataset resolution):
+        retry each within the infra budget (as a classic run —
         scan-resume recovers whatever checkpoints exist), else settle
         it failed. Preemption propagates (the daemon is going away)."""
         error_text = f"{type(exc).__name__}: {exc}"
         fclass = classify_failure(exc)
         if fclass == PREEMPTION:
-            for e in p.members:
+            for e in members:
                 self._requeue(e, reason=f"preempted at setup: {error_text}")
             raise exc
-        for e in p.members:
+        for e in members:
             tid = e.trial_id
             if self.attempts.get(tid, 0) == 0:
                 self.attempts[tid] = 1
@@ -1196,6 +1350,7 @@ class SweepService:
                 "unblocked": list(self._defrag_unblocked),
                 "pending_unblock": sorted(self._defrag_targets),
             },
+            "dataset_cache": self.store.stats(),
         }
 
     def write_books(self) -> str:
@@ -1227,7 +1382,9 @@ class SweepService:
             self.pool,
             max_lanes=self.max_lanes,
             now=now,
-            can_start=lambda e: now >= e.not_before,
+            can_start=lambda e: (
+                now >= e.not_before and self._data_ready(e)
+            ),
         )
         for p in placements:
             self._start_placement(p)
@@ -1306,6 +1463,7 @@ class SweepService:
         _emit("service_end", outcome=outcome, wall_s=round(time.time() - t0, 3))
         if self._farm is not None:
             self._farm.shutdown()
+        self.store.shutdown()
         return {
             "outcome": outcome,
             "wall_s": round(time.time() - t0, 3),

@@ -87,6 +87,11 @@ class PendingTrial:
     cost: float
     submit_ts: float
     trial_id: int = -1
+    # The dataset shape class admission probed (opaque here): the
+    # runtime re-checks it against the RESOLVED dataset at placement,
+    # so a source that drifted after the probe fails its own member
+    # only — never the co-packed bucket.
+    data_sig: Optional[tuple] = None
     resume_scan: bool = False
     pinned_start: Optional[int] = None
     blocked_since: Optional[float] = None

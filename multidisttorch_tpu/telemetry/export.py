@@ -269,6 +269,8 @@ def prometheus_dump(
             for field in (
                 "dispatches", "steps", "lane_steps", "total_s",
                 "steps_per_s", "per_lane_steps_per_s",
+                "wait_s", "input_bytes", "input_bound_frac",
+                "input_bytes_per_s",
             ):
                 if field in snap:
                     n = _prom_name(f"step_{field}")
@@ -339,6 +341,11 @@ class SweepFold:
         # every attempt record — hpo/ledger.py): goodput and settle
         # accounting keyed by tenant. Empty on untagged streams.
         self.tenants: dict[str, dict] = {}
+        # Input-stall books folded off input_wait events (one per
+        # stacked round, cumulative): the post-hoc / console mirror of
+        # the registry's StepSeries wait book (docs/DATA.md). Keyed by
+        # step-series key ("bucket-g0").
+        self.input: dict[str, dict] = {}
 
     def _trial(self, tid: int) -> dict:
         return self.trials.setdefault(
@@ -403,6 +410,28 @@ class SweepFold:
                         if v is not None:
                             book[f] = max(book.get(f) or 0, int(v))
                     book["memory_source"] = data.get("source")
+        if kind == "input_wait":
+            data = ev.get("data") or {}
+            key = data.get("key") or (
+                f"bucket-g{ev.get('group_id')}"
+                if ev.get("group_id") is not None
+                else "?"
+            )
+            wall = float(data.get("wall_s") or 0.0)
+            wait = float(data.get("wait_s") or 0.0)
+            self.input[key] = {
+                "wait_s": round(wait, 4),
+                "bytes": int(data.get("bytes") or 0),
+                "wall_s": round(wall, 4),
+                "input_bound_frac": (
+                    round(min(1.0, wait / wall), 4) if wall > 0 else None
+                ),
+                "bytes_per_s": (
+                    round(int(data.get("bytes") or 0) / wall, 1)
+                    if wall > 0
+                    else None
+                ),
+            }
         if kind.startswith("anomaly_"):
             self.anomalies += 1
         if kind == "compile_end":
@@ -727,6 +756,31 @@ def run_summary(
             "admissions": fold.admissions,
         },
     }
+    # Input-stall books (docs/DATA.md): the registry's wait book per
+    # step series when live, else the event-carried fold — surfaced
+    # top-level so the dataplane bench and console read one place.
+    input_books: dict = {}
+    if registry is not None:
+        for key, snap in registry.step_series_snapshots().items():
+            if snap.get("wait_s"):
+                input_books[key] = {
+                    "wait_s": round(snap["wait_s"], 4),
+                    "bytes": snap.get("input_bytes", 0),
+                    "input_bound_frac": (
+                        round(snap["input_bound_frac"], 4)
+                        if snap.get("input_bound_frac") is not None
+                        else None
+                    ),
+                    "bytes_per_s": (
+                        round(snap["input_bytes_per_s"], 1)
+                        if snap.get("input_bytes_per_s") is not None
+                        else None
+                    ),
+                }
+    for key, book in fold.input.items():
+        input_books.setdefault(key, book)
+    if input_books:
+        out["input"] = {k: input_books[k] for k in sorted(input_books)}
     if fold.pbt:
         out["pbt"] = fold.pbt
     if fold.tenants:

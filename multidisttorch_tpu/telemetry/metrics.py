@@ -130,7 +130,8 @@ class StepSeries:
 
     __slots__ = (
         "dispatch", "device", "steps", "lane_steps", "dispatches",
-        "total_s", "_last", "_marks", "_sample_every",
+        "total_s", "wait_s", "input_bytes", "_last", "_marks",
+        "_sample_every",
     )
 
     def __init__(self, sample_every: int = 100):
@@ -140,6 +141,12 @@ class StepSeries:
         self.lane_steps = 0
         self.dispatches = 0
         self.total_s = 0.0
+        # Input-stall book (docs/DATA.md): seconds the dispatch loop
+        # spent BLOCKED obtaining the next device-ready batch (fed by
+        # the stacked iterator's wait hook), plus the host bytes that
+        # crossed — input_bound_frac and bytes/sec derive from these.
+        self.wait_s = 0.0
+        self.input_bytes = 0
         self._last: Optional[float] = None
         self._marks = 0
         self._sample_every = max(0, int(sample_every))
@@ -194,18 +201,33 @@ class StepSeries:
         the straggler detector read boundary work as a slow step."""
         self._last = None
 
+    def note_wait(self, dt: float, nbytes: int = 0) -> None:
+        """Record one input stall: ``dt`` seconds the dispatch loop sat
+        blocked obtaining a batch that carried ``nbytes`` host bytes.
+        O(1), no locking — same single-writer discipline as mark()."""
+        self.wait_s += dt
+        self.input_bytes += nbytes
+
     def snapshot(self) -> dict:
         out = {
             "dispatches": self.dispatches,
             "steps": self.steps,
             "lane_steps": self.lane_steps,
             "total_s": self.total_s,
+            "wait_s": self.wait_s,
+            "input_bytes": self.input_bytes,
             "dispatch": self.dispatch.stats(),
             "device_sampled": self.device.stats(),
         }
         if self.total_s > 0:
             out["steps_per_s"] = self.steps / self.total_s
             out["per_lane_steps_per_s"] = self.lane_steps / self.total_s
+            # The stall intervals happen INSIDE the mark-to-mark
+            # timeline, so their ratio to total_s is the fraction of
+            # dispatch wall the loop spent input-blocked (clamped: the
+            # round's first batch waits before its opening mark).
+            out["input_bound_frac"] = min(1.0, self.wait_s / self.total_s)
+            out["input_bytes_per_s"] = self.input_bytes / self.total_s
         return out
 
 

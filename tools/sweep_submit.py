@@ -65,6 +65,14 @@ def main(argv=None) -> int:
     parser.add_argument("--hidden-dim", type=int, default=None)
     parser.add_argument("--latent-dim", type=int, default=None)
     parser.add_argument("--fused-steps", type=int, default=None)
+    parser.add_argument(
+        "--dataset", default=None,
+        help="per-submission dataset reference (docs/DATA.md): "
+        "'synthetic-mnist?rows=512&seed=3', 'file:<path>.npz', or "
+        "'cas:<sha256>' — resolved against the service's "
+        "content-addressed cache at admission; omitted = the "
+        "service's shared dataset",
+    )
     args = parser.parse_args(argv)
 
     cfg = {}
@@ -76,6 +84,7 @@ def main(argv=None) -> int:
         ("hidden_dim", args.hidden_dim),
         ("latent_dim", args.latent_dim),
         ("fused_steps", args.fused_steps),
+        ("dataset", args.dataset),
     ):
         if value is not None:
             cfg[field] = value

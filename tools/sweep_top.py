@@ -101,7 +101,29 @@ def snapshot(state: SweepFold, path: str) -> dict:
         # per-generation best/median loss, exploit count, and rank
         # churn — {} when the stream carries no PBT run.
         "pbt": state.pbt,
+        # Input-stall books (docs/DATA.md): per-series wait seconds,
+        # input-bound fraction, and host->device bytes/sec folded off
+        # the stacked feed's input_wait events.
+        "input": state.input,
     }
+
+
+def input_frac(state: SweepFold, tid: int) -> "float | None":
+    """Trial ``tid``'s input-bound fraction: its step series' (or its
+    bucket's) folded input_wait book, None when the stream carries no
+    input accounting."""
+    t = state.trials.get(tid)
+    keys = [f"trial-{tid}"]
+    key = state.series_key_of(tid)
+    if key:
+        keys.append(key)
+    if t and t.get("group") is not None:
+        keys.append(f"bucket-g{t['group']}")
+    for k in keys:
+        book = state.input.get(k)
+        if book and book.get("input_bound_frac") is not None:
+            return book["input_bound_frac"]
+    return None
 
 
 def render(state: SweepFold, path: str) -> str:
@@ -145,6 +167,7 @@ def render(state: SweepFold, path: str) -> str:
         rate = t["step"] / wall if wall and t["step"] else None
         key = state.series_key_of(tid)
         book = state.device.get(key, {}) if key else {}
+        in_frac = input_frac(state, tid)
         rows.append(
             [
                 tid,
@@ -160,6 +183,7 @@ def render(state: SweepFold, path: str) -> str:
                 t["retries"],
                 t["faults"],
                 t["lane"] if t["lane"] is not None else "-",
+                f"{in_frac * 100:.1f}%" if in_frac is not None else "-",
                 fmt_mfu(live_mfu(state, tid, rate)),
                 fmt_bytes(book.get("peak_bytes")),
                 t.get("anomalies", 0) or "-",
@@ -177,9 +201,40 @@ def render(state: SweepFold, path: str) -> str:
             rows,
             ["trial", "status", "att", "epoch", "steps", "step rate",
              "train loss", "test loss", "retries", "faults", "lane",
-             "mfu", "peak mem", "anom", "admit", "compile", "wall"],
+             "in%", "mfu", "peak mem", "anom", "admit", "compile",
+             "wall"],
         )
     )
+    if state.input:
+        # Input-stall books (docs/DATA.md): how long each stacked feed
+        # sat blocked on its host gather, and the host->device rate.
+        lines.append("")
+        irows = []
+        for key in sorted(state.input):
+            b = state.input[key]
+            irows.append(
+                [
+                    key,
+                    f"{b.get('wait_s', 0.0):.2f}s",
+                    (
+                        f"{b['input_bound_frac'] * 100:.1f}%"
+                        if b.get("input_bound_frac") is not None
+                        else "-"
+                    ),
+                    fmt_bytes(b.get("bytes")),
+                    (
+                        fmt_bytes(b["bytes_per_s"]) + "/s"
+                        if b.get("bytes_per_s") is not None
+                        else "-"
+                    ),
+                ]
+            )
+        lines.append(
+            fmt_table(
+                irows,
+                ["input series", "wait", "in-bound", "bytes", "rate"],
+            )
+        )
     if state.compile_books:
         # Per-program compile books (docs/COMPILE.md): where the
         # sweep's compile-seconds went, how they were paid (farm
