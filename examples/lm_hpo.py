@@ -45,6 +45,89 @@ from multidisttorch_tpu.train.lm import (  # noqa: E402
 )
 
 
+def _plan_mpmd_pipeline(args) -> None:
+    """Plan (and print) a 2-stage MPMD pipelined LM trial over this
+    device world: the balanced param split, the slice-vector placement
+    (``SlicePool.alloc_multi`` — the service's all-or-nothing rule),
+    the GPipe schedule model, and the ZeRO sharded-update
+    optimizer-memory table (docs/PARALLEL.md). Exits before training —
+    the executing MPMD runner covers the VAE family; the LM family
+    plugs into the same generic stage contract when a deep split
+    lands."""
+    from multidisttorch_tpu.parallel.pipeline import (
+        analytic_bubble_fraction,
+    )
+    from multidisttorch_tpu.service.scheduler import SlicePool
+
+    world = len(jax.devices())
+    groups = mdt.setup_groups(1)
+    model = TransformerLM(
+        vocab_size=args.vocab, d_model=args.d_model,
+        num_layers=args.layers, max_len=args.seq_len,
+        attention=make_ring_attention(groups[0], causal=True),
+    )
+    abstract = jax.eval_shape(
+        lambda rng: model.init(
+            {"params": rng}, jnp.zeros((1, args.seq_len), jnp.int32)
+        )["params"],
+        jax.random.key(0),
+    )
+    leaves = jax.tree.leaves_with_path(abstract) if hasattr(
+        jax.tree, "leaves_with_path"
+    ) else [
+        ((), leaf) for leaf in jax.tree.leaves(abstract)
+    ]
+    sizes = [int(np.prod(l.shape)) for _, l in leaves]
+    total = sum(sizes)
+    # Balanced 2-stage split by cumulative parameter count.
+    acc, cut = 0, len(sizes)
+    for i, s in enumerate(sizes):
+        acc += s
+        if acc >= total / 2:
+            cut = i + 1
+            break
+    stage_params = [sum(sizes[:cut]), sum(sizes[cut:])]
+
+    per_stage = max(1, world // 4)
+    pool = SlicePool(world)
+    starts = pool.alloc_multi([per_stage, per_stage])
+    m = max(2, args.fused_steps)
+    n_data = per_stage  # 1 device per slice in the example world
+    opt_total = 2 * total * 4  # Adam mu+nu, f32
+    opt_zero = opt_total // max(1, n_data)
+
+    print(f"MPMD pipeline plan ({world}-device world, docs/PARALLEL.md)")
+    print(
+        f"  model: TransformerLM vocab={args.vocab} d_model="
+        f"{args.d_model} layers={args.layers} -> {total:,} params"
+    )
+    print(
+        f"  2-stage balanced split: stage0 {stage_params[0]:,} / "
+        f"stage1 {stage_params[1]:,} params (cut after leaf {cut})"
+    )
+    print(
+        f"  slice vector: sizes ({per_stage}, {per_stage}) -> "
+        f"all-or-nothing starts {starts} "
+        f"(SlicePool.alloc_multi, largest-first, rollback-on-failure)"
+    )
+    for mm in sorted({m, 4, 8, 16}):
+        print(
+            f"  schedule model: S=2 M={mm} -> bubble "
+            f"{analytic_bubble_fraction(2, mm):.3f}  "
+            "((S-1)/(S-1+M))"
+        )
+    print(
+        f"  optimizer memory: replicated {opt_total:,} B/device -> "
+        f"zero_update {opt_zero:,} B/device over data extent {n_data} "
+        "(+ small replicated leaves)"
+    )
+    print(
+        "  dry run: plan only — submit a pipeline_stages=2 VAE-family "
+        "config to the sweep service, or run bench.py --pipeline, for "
+        "an executing trial"
+    )
+
+
 def main():
     parser = argparse.ArgumentParser(
         description="trial-parallel x sequence-parallel LM sweep"
@@ -80,7 +163,23 @@ def main():
         "--model-parallel the experts shard over the model axis "
         "(expert parallelism) while the context rides the ring",
     )
+    parser.add_argument(
+        "--pipeline", action="store_true",
+        help="plan a cross-submesh MPMD pipelined LM trial "
+        "(docs/PARALLEL.md): balanced 2-stage param split, the "
+        "all-or-nothing slice-vector placement over this world, the "
+        "GPipe schedule model, and the ZeRO optimizer-memory table — "
+        "then exit (the executing MPMD runner covers the VAE family; "
+        "see bench.py --pipeline)",
+    )
+    parser.add_argument(
+        "--dry-run", action="store_true",
+        help="with --pipeline: plan only (implied; kept explicit for "
+        "the CI smoke)",
+    )
     args = parser.parse_args()
+    if args.dry_run and not args.pipeline:
+        parser.error("--dry-run only applies with --pipeline")
     if args.fused_steps < 1 or args.steps % args.fused_steps:
         parser.error(
             f"--fused-steps {args.fused_steps} must be >= 1 and divide "
@@ -88,6 +187,9 @@ def main():
         )
 
     mdt.initialize_runtime()
+    if args.pipeline:
+        _plan_mpmd_pipeline(args)
+        return
     if args.model_parallel > 1:
         if args.moe:
             if args.moe % args.model_parallel:

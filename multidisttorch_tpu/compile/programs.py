@@ -61,6 +61,14 @@ STACKED_MULTI = "stacked_multi"
 # One whole PBT generation (S-step train scan + E-batch eval scan +
 # in-program lane exchange) as one program: hpo/pbt.py's fused path.
 PBT_GEN = "pbt_gen"
+# MPMD pipeline stage programs (parallel/pipeline.py MpmdPipeline):
+# each stage of a cross-submesh pipelined trial owns a DISTINCT
+# (forward, backward, update) program triple pinned to that stage's
+# submesh — per-stage programs are first-class registry citizens, so a
+# re-placed/retried pipelined trial's stages come back as cache hits.
+PIPE_FWD = "pipe_fwd"
+PIPE_BWD = "pipe_bwd"
+PIPE_UPDATE = "pipe_update"
 
 
 def mesh_fingerprint(trial: TrialMesh) -> tuple:
@@ -149,6 +157,46 @@ def pbt_gen_key(
     )
 
 
+def pipeline_stage_keys(
+    stage_meshes,
+    cfg,
+    bucket_key: tuple,
+    *,
+    microbatches: int,
+) -> dict:
+    """Registry keys for every program of an MPMD pipelined trial:
+    ``{(which, stage): key}`` with ``which`` in fwd/bwd/update — the
+    shape expected by ``MpmdPipeline(registry_keys=...)``. The extra
+    slot bakes what XLA bakes: the stage index, stage count, microbatch
+    count (the schedule's static shapes), the scalar hypers the
+    single-path programs bake (lr enters the update closure, beta the
+    loss), and the zero_update mode — a sharded-update trial's
+    programs pin data-sharded opt-state layouts a replicated twin's
+    executable cannot serve (the same hazard ``aot_eligible`` guards
+    on the single path). Each key carries ITS stage's mesh
+    fingerprint — stage 0's executable can never serve stage 1's
+    submesh."""
+    kinds = {"fwd": PIPE_FWD, "bwd": PIPE_BWD, "update": PIPE_UPDATE}
+    out = {}
+    n_stages = len(stage_meshes)
+    for s, mesh in enumerate(stage_meshes):
+        for which, kind in kinds.items():
+            out[(which, s)] = (
+                kind,
+                bucket_key,
+                (
+                    int(s),
+                    int(n_stages),
+                    int(microbatches),
+                    float(cfg.lr),
+                    float(cfg.beta),
+                    bool(getattr(cfg, "zero_update", False)),
+                ),
+                mesh_fingerprint(mesh),
+            )
+    return out
+
+
 def program_label(key: tuple) -> str:
     """Human-readable program name for events/metrics/console — the
     bucket signature, lane count or hypers, and the anchor device, in
@@ -171,6 +219,9 @@ def _program_label(key: tuple) -> str:
         sig += "-rm"
     if kind in (STACKED_TRAIN, STACKED_MULTI):
         sig += f"-K{extra}"
+    elif kind in (PIPE_FWD, PIPE_BWD, PIPE_UPDATE):
+        stage, n_stages, microbatches = extra[:3]
+        sig += f"-s{stage}of{n_stages}-M{microbatches}"
     elif kind == PBT_GEN:
         lanes, spg, ebatches, n_exploit = extra[:4]
         sig += f"-K{lanes}-S{spg}-E{ebatches}-x{n_exploit}"

@@ -146,6 +146,24 @@ class TrialConfig:
     # another). Trials with DIFFERENT datasets of the same shape class
     # still co-pack into one stacked bucket (heterogeneous lanes).
     dataset: str = ""
+    # ZeRO-style sharded weight update (docs/PARALLEL.md): partition
+    # the Adam moments over the trial submesh's data axis — GSPMD
+    # reduce-scatters the gradient into the owned shard's update and
+    # all-gathers the fresh params (arXiv 2004.13336). Params stay
+    # replicated, so the forward/backward is the plain DDP program;
+    # per-device optimizer memory drops to ~1/n_data of replicated.
+    # Runs the classic (unstacked) path; no-op on 1-device submeshes.
+    zero_update: bool = False
+    # Cross-submesh MPMD pipeline parallelism (docs/PARALLEL.md): >1
+    # makes this trial a VECTOR of slice requests — each stage owns its
+    # own submesh and programs, driven on a GPipe microbatch schedule
+    # with device_put transfers between stages. `grad_accum` doubles as
+    # the microbatch count M (the schedule IS gradient accumulation;
+    # the single-mesh grad_accum=M step is the parity reference).
+    # Placed by the sweep service (all-or-nothing multi-block) or run
+    # directly via hpo.pipeline_run.run_pipeline_trial; run_hpo's
+    # equal-groups carve cannot host it and rejects such configs.
+    pipeline_stages: int = 1
 
 
 @dataclass
@@ -189,6 +207,44 @@ class TrialResult:
     # (docs/STACKING.md): K same-shape trials vmapped through one
     # compiled program on one submesh.
     stacked: bool = False
+    # Analytic per-device optimizer-state footprint (docs/PARALLEL.md
+    # memory books): what ONE device holds for this trial's Adam
+    # moments, from each leaf's concrete sharding — the ZeRO win is
+    # visible here without memory_stats() (CPU included). For a
+    # stacked lane this is the lane's share of the bucket's stacked
+    # state; for a pipelined trial, the sum over its stages.
+    optimizer_state_bytes: int = 0
+
+
+def config_mismatch_vs_meta(cfg: TrialConfig, meta: dict) -> dict:
+    """Fields (epochs excluded — extending epochs is the legitimate
+    resume use) where a checkpoint's recorded config differs from
+    ``cfg``; empty dict = match. Fields absent from an older
+    checkpoint's sidecar compare against their TrialConfig default —
+    a checkpoint written before a field existed was trained under its
+    default. The ONE copy of the resume config-match rule: the classic
+    ``_TrialRun`` and the pipelined runner's per-stage scan restore
+    both gate on it."""
+    from dataclasses import MISSING, fields as dc_fields
+
+    field_defaults = {
+        f.name: f.default
+        for f in dc_fields(TrialConfig)
+        if f.default is not MISSING
+    }
+    saved = {
+        k: meta.get(k, field_defaults.get(k))
+        for k in asdict(cfg)
+        if k != "epochs" and (k in meta or k in field_defaults)
+    }
+    current = {k: v for k, v in asdict(cfg).items() if k != "epochs"}
+    if not saved or saved == current:
+        return {}
+    return {
+        k: (saved.get(k), current[k])
+        for k in current
+        if saved.get(k) != current[k]
+    }
 
 
 def _result_summary(result: TrialResult) -> dict:
@@ -207,6 +263,7 @@ def _result_summary(result: TrialResult) -> dict:
         "dataset_synthetic": result.dataset_synthetic,
         "stacked": result.stacked,
         "resumed_from_step": result.resumed_from_step,
+        "optimizer_state_bytes": result.optimizer_state_bytes,
     }
 
 
@@ -234,6 +291,7 @@ def _result_from_summary(
         stacked=bool(s.get("stacked", False)),
         attempt=int(rec.get("attempt", 1)),
         resumed_from_step=int(s.get("resumed_from_step", 0)),
+        optimizer_state_bytes=int(s.get("optimizer_state_bytes", 0)),
     )
 
 
@@ -276,6 +334,14 @@ class _TrialRun:
             raise ValueError(
                 f"fused_steps must be >= 1, got {cfg.fused_steps} "
                 f"(trial {cfg.trial_id})"
+            )
+        if cfg.pipeline_stages != 1:
+            raise ValueError(
+                f"trial {cfg.trial_id} has pipeline_stages="
+                f"{cfg.pipeline_stages}: an MPMD pipelined trial is a "
+                "vector of submeshes and runs through "
+                "hpo.pipeline_run._PipelineTrialRun (service placement "
+                "or run_pipeline_trial), not _TrialRun"
             )
         self.trial = trial
         self.cfg = cfg
@@ -371,6 +437,11 @@ class _TrialRun:
         aot_eligible = (
             model_builder is None
             and param_shardings_builder is None
+            # The sharded-update variant pins different state shardings
+            # into its programs — the registry's single-path keys don't
+            # carry the mode, so a zero trial must never take (or
+            # donate) a replicated twin's executable.
+            and not cfg.zero_update
             and jax.process_count() == 1
             and os.environ.get("MDT_AOT_ADMISSION", "1") != "0"
         )
@@ -393,6 +464,22 @@ class _TrialRun:
         self._state_sh = (
             state_shardings(self.state) if param_sh is not None else None
         )
+        # Sharded weight update (docs/PARALLEL.md): re-place the Adam
+        # moments data-sharded and pin the layout into every step. The
+        # forward/backward stays the replicated program; only the
+        # update's reduce-scatter/all-gather schedule changes.
+        if cfg.zero_update and trial.data_size > 1:
+            if param_sh is not None:
+                raise ValueError(
+                    f"trial {cfg.trial_id}: zero_update composes with "
+                    "weight sharding via parallel.fsdp."
+                    "fsdp_compose_shardings, not via both knobs at once "
+                    "(the param_shardings_builder already owns the "
+                    "state layout)"
+                )
+            from multidisttorch_tpu.parallel.fsdp import place_zero_state
+
+            self.state, self._state_sh = place_zero_state(trial, self.state)
         # Checkpointing a weight-sharded state: serialization needs the
         # whole array on the writer host, but on a spanning submesh the
         # writer holds only its shards. The gather-to-replicated below
@@ -400,9 +487,27 @@ class _TrialRun:
         # rule as every other step); only the fetch stays writer-gated.
         self._gather_state = (
             jax.jit(lambda s: s, out_shardings=trial.replicated_sharding)
-            if param_sh is not None
+            if self._state_sh is not None
             else None
         )
+        # Memory books (docs/PARALLEL.md): the analytic per-device
+        # optimizer footprint from the placed state's CONCRETE
+        # shardings — the ZeRO win is visible on every backend, no
+        # memory_stats() needed.
+        from multidisttorch_tpu.parallel.fsdp import optimizer_state_bytes
+
+        _ob = optimizer_state_bytes(self.state)
+        self.result.optimizer_state_bytes = _ob["per_device_bytes"]
+        _bus = get_bus()
+        if _bus is not None:
+            _bus.emit(
+                "optimizer_state",
+                trial_id=cfg.trial_id,
+                group_id=trial.group_id,
+                per_device_bytes=_ob["per_device_bytes"],
+                total_bytes=_ob["total_bytes"],
+                zero_update=bool(cfg.zero_update),
+            )
         self.train_step = make_train_step(
             trial, model, tx, beta=cfg.beta, remat=cfg.remat,
             grad_accum=cfg.grad_accum, shardings=self._state_sh,
@@ -557,33 +662,7 @@ class _TrialRun:
         )
 
     def _config_mismatch(self, meta: dict) -> dict:
-        """Fields (epochs excluded — extending epochs is the legitimate
-        resume use) where the checkpoint's recorded config differs from
-        the current one; empty dict = match. Fields absent from an older
-        checkpoint's sidecar compare against their TrialConfig default —
-        a checkpoint written before a field existed was trained under
-        its default."""
-        from dataclasses import MISSING, fields as dc_fields
-
-        cfg = self.cfg
-        field_defaults = {
-            f.name: f.default
-            for f in dc_fields(TrialConfig)
-            if f.default is not MISSING
-        }
-        saved = {
-            k: meta.get(k, field_defaults.get(k))
-            for k in asdict(cfg)
-            if k != "epochs" and (k in meta or k in field_defaults)
-        }
-        current = {k: v for k, v in asdict(cfg).items() if k != "epochs"}
-        if not saved or saved == current:
-            return {}
-        return {
-            k: (saved.get(k), current[k])
-            for k in current
-            if saved.get(k) != current[k]
-        }
+        return config_mismatch_vs_meta(self.cfg, meta)
 
     def _restore_scan(self):
         """Scan-back restore for supervised retries and elastic
@@ -1465,8 +1544,15 @@ def stack_bucket_key(cfg: TrialConfig) -> tuple:
 def config_is_stackable(cfg: TrialConfig) -> bool:
     """Whether a config can ride a stacked bucket at all. Sampled eval
     is the one per-trial knob the stacked eval step does not carry
-    (posterior-mean eval only); such configs run the classic path."""
-    return not cfg.eval_sampled
+    (posterior-mean eval only); a sharded-update (zero_update) state
+    shards over the submesh where stacked states replicate; a
+    pipelined trial is a vector of submeshes. All three run their own
+    paths."""
+    return (
+        not cfg.eval_sampled
+        and not cfg.zero_update
+        and cfg.pipeline_stages == 1
+    )
 
 
 def data_shape_sig(ds: Dataset, batch_size: int) -> tuple:
@@ -1988,6 +2074,26 @@ class _StackedBucketRun:
         # Lane slice out of the stacked state: a compiled dynamic-index
         # read (traced k — every retirement reuses one executable).
         lane_state = self.read_lane(self.state, np.int32(k))
+        # Memory books: a stacked lane's optimizer footprint is its
+        # slice of the (replicated) stacked state — the number
+        # comparable against an unstacked replicated or zero_update
+        # twin in run_summary/sweep_top.
+        from multidisttorch_tpu.parallel.fsdp import optimizer_state_bytes
+
+        result.optimizer_state_bytes = optimizer_state_bytes(
+            lane_state
+        )["per_device_bytes"]
+        _bus = get_bus()
+        if _bus is not None:
+            _bus.emit(
+                "optimizer_state",
+                trial_id=cfg.trial_id,
+                group_id=self.trial.group_id,
+                lane=k,
+                per_device_bytes=result.optimizer_state_bytes,
+                total_bytes=result.optimizer_state_bytes,
+                zero_update=False,
+            )
         if self._is_writer:
             if self._save_checkpoint:
                 host_state = jax.device_get(lane_state)
@@ -2593,6 +2699,14 @@ def _run_hpo_body(
     # deterministic, so multi-controller processes agree without
     # communicating — but shard_across_trials partitions ONE shared
     # dataset across trials, which a per-trial dataset contradicts.
+    if any(getattr(cfg, "pipeline_stages", 1) != 1 for cfg in configs):
+        raise ValueError(
+            "pipeline_stages > 1 trials are vectors of slice requests "
+            "— run_hpo's equal-groups carve cannot host them. Submit "
+            "them to the sweep service (multi-block placement, "
+            "docs/SERVICE.md) or drive one directly with "
+            "hpo.pipeline_run.run_pipeline_trial"
+        )
     data_by_idx: dict[int, Dataset] = {}
     if any(getattr(cfg, "dataset", "") for cfg in configs):
         if shard_across_trials:

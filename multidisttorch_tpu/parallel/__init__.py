@@ -16,11 +16,21 @@ from multidisttorch_tpu.parallel.collectives import (
     group_pmean,
     group_psum,
 )
-from multidisttorch_tpu.parallel.fsdp import fsdp_param_shardings
+from multidisttorch_tpu.parallel.fsdp import (
+    fsdp_param_shardings,
+    optimizer_state_bytes,
+    place_zero_state,
+    zero_update_shardings,
+)
 from multidisttorch_tpu.parallel.pipeline import (
+    MpmdPipeline,
+    analytic_bubble_fraction,
+    make_mpmd_reference_step,
+    make_vae_stage_fns,
     pack_stage_params,
     pipeline_apply,
     pipeline_apply_stages,
+    split_stage_params,
     stage_params_sharding,
     unpack_stage_params,
 )

@@ -446,3 +446,718 @@ def sequential_stages_reference(stage_fns, stage_params, batch):
     for fn, p in zip(stage_fns, stage_params):
         x = fn(p, x)
     return x
+
+
+# --- cross-submesh MPMD pipeline parallelism ------------------------
+#
+# Everything above is SPMD pipelining: one mesh, one program, the stage
+# dimension a mesh axis. The MPMD form (arXiv 2412.14374) drops both
+# constraints: each stage owns its OWN submesh and runs its OWN
+# compiled programs — a 2-stage trial is a *vector* of slice requests
+# to the service scheduler (all-or-nothing multi-block placement,
+# ``service/scheduler.py``), per-stage programs are first-class ``kind``s
+# in the compile registry (``compile/programs.py``), and the host drives
+# the classic GPipe fill/steady/drain schedule with explicit
+# ``jax.device_put`` transfers carrying activations (forward) and
+# cotangents (backward) between stage submeshes.
+#
+# Contract per stage:
+#   - ``stage_fns[s](params_s, acts, rng) -> acts'`` for s < S-1, where
+#     ``acts`` is a tuple of batch-major arrays (stage 0 receives
+#     ``(batch,)``);
+#   - ``last_fn(params_{S-1}, acts) -> loss`` (per-sample mean over the
+#     microbatch) closes the chain.
+# The backward pass is recompute-vjp per stage (GPipe's activation
+# policy: only the stage INPUTS are stashed between phases; the vjp
+# re-runs the stage forward), so per-stage programs are:
+# fwd / last-forward (loss metric) / bwd (cotangent in, grads out) /
+# last-bwd / update (per-stage Adam; optionally ZeRO-sharded over the
+# stage submesh's data axis — ``parallel/fsdp.py``'s sharded-update
+# composes per stage unchanged).
+#
+# Schedule: two phases of ``M + S - 1`` ticks each (forward fill/drain,
+# then backward fill/drain), microbatch gradients accumulated in
+# arrival order — the same ascending-microbatch summation as
+# ``train.steps.accumulate_gradients``, which is what makes the
+# single-mesh reference (:func:`make_mpmd_reference_step`) the parity
+# anchor. Bubble fraction: each stage is busy 2M of the 2(M+S-1) ticks,
+# so the schedule's idle fraction is (S-1)/(M+S-1) — the books record
+# busy/idle per dispatch (a MEASURED schedule property, not the
+# formula), and `bench.py --pipeline` gates the two against each other.
+
+
+def make_vae_stage_fns(model, beta: float):
+    """The flagship VAE as a 2-stage MPMD chain.
+
+    Stage 0 (encoder + reparameterization): ``(x,) -> (z, mu, logvar,
+    x_flat)`` — mu/logvar and the flattened input ride the activation
+    tuple because the ELBO at the far end needs them. Stage 1 (decoder
+    + loss): logits from z, per-sample-mean negative ELBO.
+
+    The reparameterization draws ``eps = normal(rng, ...)`` from the
+    microbatch's explicit key rather than flax's ``make_rng`` fold, so
+    the same math composes unchanged into the single-mesh reference
+    step (:func:`make_mpmd_reference_step`) — the parity contract is
+    between the pipelined and un-pipelined execution of THIS forward,
+    with identical per-microbatch noise by construction.
+
+    Returns ``(stage_fns, last_fn, stage_param_keys)`` where
+    ``stage_param_keys`` names each stage's top-level param modules
+    (:func:`split_stage_params`).
+    """
+    from multidisttorch_tpu.ops.losses import elbo_loss_sum
+
+    def encode_stage(params, acts, rng):
+        (x,) = acts
+        mu, logvar = model.apply({"params": params}, x, method="encode")
+        eps = jax.random.normal(rng, mu.shape, dtype=jnp.float32).astype(
+            mu.dtype
+        )
+        z = mu + eps * jnp.exp(0.5 * logvar)
+        flat = x.reshape(x.shape[0], -1).astype(jnp.float32)
+        return (z, mu, logvar, flat)
+
+    def decode_loss_stage(params, acts):
+        z, mu, logvar, flat = acts
+        logits = model.apply({"params": params}, z, method="decode")
+        m = flat.shape[0]
+        return elbo_loss_sum(logits, flat, mu, logvar, beta) / m
+
+    return [encode_stage], decode_loss_stage, (
+        ("fc1", "fc21", "fc22"),
+        ("fc3", "fc4"),
+    )
+
+
+def make_vae_stage_eval_fns(model, beta: float):
+    """Posterior-mean eval split along the same 2-stage boundary:
+    ``enc_eval(params0, batch) -> (mu, logvar, flat)`` on stage 0,
+    ``dec_eval(params1, acts, weights) -> weighted loss_sum`` on the
+    last stage — the pipelined sibling of the driver's masked
+    ``make_eval_step``."""
+    from multidisttorch_tpu.ops.losses import elbo_loss_weighted_sum
+
+    def enc_eval(params, batch):
+        mu, logvar = model.apply({"params": params}, batch, method="encode")
+        flat = batch.reshape(batch.shape[0], -1).astype(jnp.float32)
+        return (mu, logvar, flat)
+
+    def dec_eval(params, acts, weights):
+        mu, logvar, flat = acts
+        logits = model.apply({"params": params}, mu, method="decode")
+        return elbo_loss_weighted_sum(
+            logits, flat, mu, logvar, weights, beta
+        ).astype(jnp.float32)
+
+    return enc_eval, dec_eval
+
+
+def split_stage_params(params, stage_param_keys) -> list:
+    """Split a full param tree into per-stage trees by top-level module
+    name. The split is exact and disjoint — training the stage trees
+    with per-stage Adam is elementwise-identical to training the full
+    tree (Adam has no cross-leaf coupling)."""
+    seen = [k for keys in stage_param_keys for k in keys]
+    if sorted(seen) != sorted(params):
+        raise ValueError(
+            f"stage split {stage_param_keys} does not partition the "
+            f"param tree {sorted(params)}"
+        )
+    return [{k: params[k] for k in keys} for keys in stage_param_keys]
+
+
+def merge_stage_params(stage_trees) -> dict:
+    """Inverse of :func:`split_stage_params` (checkpoint export, PBT
+    exchange across pipelined trials)."""
+    out: dict = {}
+    for tree in stage_trees:
+        out.update(tree)
+    return out
+
+
+def analytic_bubble_fraction(num_stages: int, num_microbatches: int) -> float:
+    """The GPipe schedule model: idle fraction (S-1)/(S-1+M)."""
+    s, m = int(num_stages), int(num_microbatches)
+    return (s - 1) / (s - 1 + m) if s > 1 else 0.0
+
+
+def _tree_bytes(tree) -> int:
+    return sum(
+        int(leaf.size) * leaf.dtype.itemsize for leaf in jax.tree.leaves(tree)
+    )
+
+
+def _avals_of(tree):
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype), tree
+    )
+
+
+class MpmdPipeline:
+    """One pipelined trial: S stages on S distinct submeshes.
+
+    Owns per-stage :class:`~multidisttorch_tpu.train.steps.TrainState`s
+    and compiled programs, and drives the GPipe microbatch schedule
+    with ``device_put`` transfers between stage submeshes. Single
+    controller (the service daemon's world); per-stage programs compile
+    through the process-lifetime executable registry when
+    ``registry_keys`` are supplied (retries and bucket-twin trials
+    never recompile a stage).
+
+    ``zero_update=True`` additionally places each stage's optimizer
+    state ZeRO-sharded over that stage submesh's data axis
+    (``parallel.fsdp.place_zero_state``) — pipeline parallelism across
+    submeshes, data parallelism + sharded weight update within each.
+    """
+
+    def __init__(
+        self,
+        stages: Sequence,  # [TrialMesh, ...]
+        stage_fns: Sequence[Callable],
+        last_fn: Callable,
+        stage_params: Sequence[Any],
+        *,
+        lr: float,
+        microbatches: int,
+        zero_update: bool = False,
+        registry_keys: Optional[dict] = None,
+        eval_fns: Optional[tuple] = None,
+    ):
+        import optax
+
+        from multidisttorch_tpu.parallel.fsdp import place_zero_state
+        from multidisttorch_tpu.train.steps import TrainState
+
+        self.stages = list(stages)
+        S = self.S = len(self.stages)
+        if S < 2:
+            raise ValueError(
+                f"an MPMD pipeline needs >= 2 stages, got {S} (a 1-stage "
+                "trial is a plain submesh trial)"
+            )
+        if len(stage_fns) != S - 1 or len(stage_params) != S:
+            raise ValueError(
+                f"{len(stage_fns)} stage_fns / {len(stage_params)} "
+                f"stage_params for {S} stages (need S-1 fns + last_fn)"
+            )
+        self.M = int(microbatches)
+        if self.M < 1:
+            raise ValueError(f"microbatches must be >= 1, got {self.M}")
+        self._stage_fns = list(stage_fns)
+        self._last_fn = last_fn
+        self._tx = optax.adam(float(lr))
+        self.zero_update = bool(zero_update)
+
+        # Per-stage states: split-tree Adam — elementwise-identical to
+        # full-tree Adam on the merged params.
+        self.states = []
+        self.state_shardings = []
+        for trial, p in zip(self.stages, stage_params):
+            st = TrainState(
+                params=p,
+                opt_state=self._tx.init(p),
+                step=jnp.zeros((), jnp.int32),
+            )
+            if self.zero_update and trial.data_size > 1:
+                st, sh = place_zero_state(trial, st)
+            else:
+                st = trial.device_put(st)
+                sh = jax.tree.map(lambda _: trial.replicated_sharding, st)
+            self.states.append(st)
+            self.state_shardings.append(sh)
+
+        self._build_programs(registry_keys or {}, eval_fns)
+
+        # Schedule books: busy/idle measured at dispatch time.
+        self.books = {
+            "steps": 0,
+            "ticks": 0,
+            "busy": 0,
+            "stage_busy": [0] * S,
+            "transfers": 0,
+            "transfer_bytes": 0,
+        }
+        # First-step argument SHAPES per program — the device cost
+        # books' input (telemetry/device.record_pipeline_cost); shapes
+        # only, so donated buffers are never retained.
+        self.cost_args: dict = {}
+
+    # -- program construction ----------------------------------------
+
+    def _registry_compile(self, key, jit_fn, avals):
+        """Compile one stage program through the executable registry
+        (one ``lower→compile`` per (kind, bucket, stage, submesh) ever;
+        concurrent same-key callers coalesce). Falls back to the plain
+        jit fn on any registry failure — MPMD execution must not hinge
+        on the compile subsystem."""
+        if key is None:
+            return jit_fn
+        try:
+            from multidisttorch_tpu.compile.registry import (
+                READY,
+                SOURCE_INLINE,
+                get_executable_registry,
+            )
+
+            reg = get_executable_registry()
+            ex = reg.take(key)
+            if ex is not None:
+                return ex
+            if reg.claim(key):
+                e = reg.compile_now(
+                    key, jit_fn, avals, source=SOURCE_INLINE
+                )
+                if e.status == READY:
+                    ex = reg.take(key)
+                    if ex is not None:
+                        return ex
+        except Exception:  # noqa: BLE001 — registry is an optimization
+            pass
+        return jit_fn
+
+    def _build_programs(self, keys: dict, eval_fns) -> None:
+        S, M = self.S, self.M
+        self._fwd = [None] * S
+        self._bwd = [None] * S
+        self._update = [None] * S
+
+        # Probe the activation shape chain abstractly: stage s's output
+        # avals are stage s+1's input avals. Shapes are per-MICROBATCH.
+        p_avals = [
+            jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), st.params
+            )
+            for st in self.states
+        ]
+        rng_aval = jax.eval_shape(lambda: jax.random.key(0))
+        self._acts_avals: list = [None] * S  # input acts per stage
+
+        for s in range(S):
+            trial = self.stages[s]
+            repl = trial.replicated_sharding
+            batch_sh = trial.batch_sharding
+            if s < S - 1:
+                fn = self._stage_fns[s]
+
+                def fwd(params, acts, rng, _fn=fn):
+                    return _fn(params, acts, rng)
+
+                def bwd(params, acts, rng, cot, _fn=fn):
+                    _, vjp = jax.vjp(
+                        lambda p, a: _fn(p, a, rng), params, acts
+                    )
+                    gp, ga = vjp(cot)
+                    return ga, gp
+
+                self._fwd[s] = jax.jit(
+                    fwd,
+                    in_shardings=(
+                        self.state_shardings[s].params, batch_sh, repl
+                    ),
+                    out_shardings=batch_sh,
+                )
+                self._bwd[s] = jax.jit(
+                    bwd,
+                    in_shardings=(
+                        self.state_shardings[s].params, batch_sh, repl,
+                        batch_sh,
+                    ),
+                    out_shardings=(batch_sh, repl),
+                )
+            else:
+                last = self._last_fn
+
+                def last_fwd(params, acts, _fn=last):
+                    return _fn(params, acts)
+
+                def last_bwd(params, acts, _fn=last):
+                    gp, ga = jax.grad(_fn, argnums=(0, 1))(params, acts)
+                    return ga, gp
+
+                self._fwd[s] = jax.jit(
+                    last_fwd,
+                    in_shardings=(
+                        self.state_shardings[s].params, batch_sh
+                    ),
+                    out_shardings=repl,
+                )
+                self._bwd[s] = jax.jit(
+                    last_bwd,
+                    in_shardings=(
+                        self.state_shardings[s].params, batch_sh
+                    ),
+                    out_shardings=(batch_sh, repl),
+                )
+
+            def update(st, gsum, _tx=self._tx, _M=M):
+                from multidisttorch_tpu.train.steps import TrainState
+
+                grads = jax.tree.map(lambda g: g / _M, gsum)
+                updates, new_opt = _tx.update(
+                    grads, st.opt_state, st.params
+                )
+                import optax as _optax
+
+                new_params = _optax.apply_updates(st.params, updates)
+                return TrainState(
+                    params=new_params, opt_state=new_opt, step=st.step + 1
+                )
+
+            self._update[s] = jax.jit(
+                update,
+                in_shardings=(self.state_shardings[s], repl),
+                out_shardings=self.state_shardings[s],
+                donate_argnums=(0,),
+            )
+
+        # Registry admission (timed, attributed, shared): needs concrete
+        # avals, which depend on the microbatch shape — resolved on
+        # first step via _admit_programs.
+        self._keys = dict(keys)
+        self._admitted = False
+        self._p_avals = p_avals
+        self._rng_aval = rng_aval
+
+        # Eval programs (posterior-mean, masked): forward-only chain.
+        self._eval_enc = self._eval_dec = None
+        if eval_fns is not None:
+            enc_eval, dec_eval = eval_fns
+            first, last_m = self.stages[0], self.stages[-1]
+            self._eval_enc = jax.jit(
+                enc_eval,
+                in_shardings=(
+                    self.state_shardings[0].params, first.batch_sharding
+                ),
+                out_shardings=first.batch_sharding,
+            )
+            self._eval_dec = jax.jit(
+                dec_eval,
+                in_shardings=(
+                    self.state_shardings[-1].params,
+                    last_m.batch_sharding,
+                    last_m.batch_sharding,
+                ),
+                out_shardings=last_m.replicated_sharding,
+            )
+
+    def _admit_programs(self, mb_shape, batch_dtype) -> None:
+        """First-step registry admission: with the microbatch shape
+        known, derive each stage program's avals and route the jit fns
+        through the executable registry (one compile per program key
+        ever — a retried/re-placed trial's stages come back as
+        ``cache_hit``s)."""
+        if self._admitted:
+            return
+        self._admitted = True
+        S = self.S
+        acts_aval = (jax.ShapeDtypeStruct(mb_shape, batch_dtype),)
+        for s in range(S):
+            self._acts_avals[s] = acts_aval
+            if s < S - 1:
+                out_aval = jax.eval_shape(
+                    self._stage_fns[s],
+                    self._p_avals[s],
+                    acts_aval,
+                    self._rng_aval,
+                )
+                self._fwd[s] = self._registry_compile(
+                    self._keys.get(("fwd", s)),
+                    self._fwd[s],
+                    (self._p_avals[s], acts_aval, self._rng_aval),
+                )
+                self._bwd[s] = self._registry_compile(
+                    self._keys.get(("bwd", s)),
+                    self._bwd[s],
+                    (
+                        self._p_avals[s], acts_aval, self._rng_aval,
+                        out_aval,
+                    ),
+                )
+                acts_aval = out_aval
+            else:
+                self._fwd[s] = self._registry_compile(
+                    self._keys.get(("fwd", s)),
+                    self._fwd[s],
+                    (self._p_avals[s], acts_aval),
+                )
+                self._bwd[s] = self._registry_compile(
+                    self._keys.get(("bwd", s)),
+                    self._bwd[s],
+                    (self._p_avals[s], acts_aval),
+                )
+            state_aval = jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype),
+                self.states[s],
+            )
+            gsum_aval = self._p_avals[s]
+            self._update[s] = self._registry_compile(
+                self._keys.get(("update", s)),
+                self._update[s],
+                (state_aval, gsum_aval),
+            )
+
+    # -- the schedule -------------------------------------------------
+
+    def _transfer(self, tree, trial) -> Any:
+        """One inter-stage hop: place the activation/cotangent tuple on
+        the destination stage's submesh, batch-sharded over its data
+        axis."""
+        self.books["transfers"] += 1
+        self.books["transfer_bytes"] += _tree_bytes(tree)
+        return jax.device_put(tree, trial.batch_sharding)
+
+    def step(self, batch, rng) -> dict:
+        """One optimizer step: M microbatches through the two-phase
+        GPipe schedule, per-stage gradient accumulation, per-stage
+        update. ``batch`` lives on stage 0's submesh; ``rng`` is the
+        step key (split into per-microbatch keys exactly like
+        ``accumulate_gradients``'s caller). Returns
+        ``{"loss_sum": <async device scalar on the last stage>}``."""
+        M, S = self.M, self.S
+        n = int(batch.shape[0])
+        if n % M:
+            raise ValueError(
+                f"batch size {n} not divisible by microbatches={M}"
+            )
+        mb = n // M
+        self._admit_programs((mb,) + tuple(batch.shape[1:]), batch.dtype)
+        rngs = jax.random.split(rng, M)
+        # Per-stage copies of the microbatch keys (the recompute-vjp
+        # backward needs the stage's forward noise).
+        stage_rngs = [
+            [
+                jax.device_put(rngs[m], self.stages[s].replicated_sharding)
+                for m in range(M)
+            ]
+            for s in range(S - 1)
+        ]
+        stash: list = [[None] * M for _ in range(S)]
+        cot: list = [[None] * M for _ in range(S)]
+        gsum: list = [None] * S
+        losses = []
+        books = self.books
+        ticks = M + S - 1
+
+        # Forward phase: stage s runs microbatch t-s at tick t; output
+        # transfers to stage s+1's submesh. Dispatches are async — the
+        # host enqueues the whole tick and moves on; XLA's dependency
+        # order IS the pipeline.
+        for t in range(ticks):
+            books["ticks"] += 1
+            for s in range(S):
+                m = t - s
+                if not (0 <= m < M):
+                    continue
+                books["busy"] += 1
+                books["stage_busy"][s] += 1
+                if s == 0:
+                    # Re-pin the slice's sharding: a sliced sharded
+                    # array comes back with whatever layout XLA chose,
+                    # and the stage program's in_shardings are exact.
+                    acts = jax.device_put(
+                        (batch[m * mb:(m + 1) * mb],),
+                        self.stages[0].batch_sharding,
+                    )
+                else:
+                    acts = stash[s][m]
+                if s < S - 1:
+                    args = (self.states[s].params, acts, stage_rngs[s][m])
+                    out = self._fwd[s](*args)
+                    stash[s][m] = acts
+                    stash[s + 1][m] = self._transfer(
+                        out, self.stages[s + 1]
+                    )
+                else:
+                    args = (self.states[s].params, acts)
+                    losses.append(self._fwd[s](*args))
+                    stash[s][m] = acts
+                if books["steps"] == 0 and m == 0:
+                    self.cost_args[("fwd", s)] = _avals_of(args)
+
+        # Backward phase: microbatch m starts at the LAST stage and
+        # cotangents hop backward; per-stage grads accumulate in
+        # ascending-m order (the accumulate_gradients order — parity).
+        for t in range(ticks):
+            books["ticks"] += 1
+            for s in reversed(range(S)):
+                m = t - (S - 1 - s)
+                if not (0 <= m < M):
+                    continue
+                books["busy"] += 1
+                books["stage_busy"][s] += 1
+                if s == S - 1:
+                    args = (self.states[s].params, stash[s][m])
+                else:
+                    args = (
+                        self.states[s].params,
+                        stash[s][m],
+                        stage_rngs[s][m],
+                        cot[s][m],
+                    )
+                if books["steps"] == 0 and m == 0:
+                    self.cost_args[("bwd", s)] = _avals_of(args)
+                cot_in, gp = self._bwd[s](*args)
+                gsum[s] = (
+                    gp
+                    if gsum[s] is None
+                    else jax.tree.map(jnp.add, gsum[s], gp)
+                )
+                if s > 0:
+                    cot[s - 1][m] = self._transfer(
+                        cot_in, self.stages[s - 1]
+                    )
+                stash[s][m] = None
+
+        for s in range(S):
+            if books["steps"] == 0:
+                self.cost_args[("update", s)] = _avals_of(
+                    (self.states[s], gsum[s])
+                )
+            self.states[s] = self._update[s](self.states[s], gsum[s])
+        books["steps"] += 1
+
+        loss_mean = losses[0]
+        for extra in losses[1:]:
+            loss_mean = loss_mean + extra
+        loss_mean = loss_mean / M
+        return {"loss_sum": (loss_mean * n).astype(jnp.float32)}
+
+    def eval_batch(self, batch, weights):
+        """Masked posterior-mean eval of one padded batch: encode on
+        stage 0, one transfer, decode+loss on the last stage. Returns
+        the weighted ``loss_sum`` (async device scalar)."""
+        if self._eval_enc is None:
+            raise ValueError("pipeline built without eval_fns")
+        acts = self._eval_enc(self.states[0].params, batch)
+        acts = self._transfer(acts, self.stages[-1])
+        w = jax.device_put(weights, self.stages[-1].batch_sharding)
+        return self._eval_dec(self.states[-1].params, acts, w)
+
+    # -- books --------------------------------------------------------
+
+    def measured_bubble(self) -> Optional[float]:
+        """Idle fraction of the schedule actually driven: 1 −
+        busy-dispatches / (S × ticks), counted per dispatch as the
+        host loop runs. Gated against
+        :func:`analytic_bubble_fraction` — and be precise about what
+        that gate pins: a correctly-driven loop yields the analytic
+        value BY CONSTRUCTION, so the gate is a schedule-STRUCTURE
+        regression guard (wrong tick set, a skipped or double-driven
+        stage, a mis-sized phase), not a device-overlap measurement.
+        Wall-clock overlap across stages — the bubble a chip actually
+        pays — needs real parallel hardware; the standing MFU caveat
+        applies until open item 5's TPU run."""
+        if self.books["ticks"] == 0:
+            return None
+        return 1.0 - self.books["busy"] / (self.S * self.books["ticks"])
+
+    def schedule_books(self) -> dict:
+        return {
+            **{
+                k: (list(v) if isinstance(v, list) else v)
+                for k, v in self.books.items()
+            },
+            "stages": self.S,
+            "microbatches": self.M,
+            "measured_bubble": self.measured_bubble(),
+            "analytic_bubble": analytic_bubble_fraction(self.S, self.M),
+            "zero_update": self.zero_update,
+        }
+
+    def cost_parts(self) -> list:
+        """The device cost books' input
+        (``telemetry.device.record_pipeline_cost``): every per-stage
+        program with its first-step arg shapes, stage devices, and
+        per-optimizer-step multiplicity (forward/backward run once per
+        microbatch, the update once). Empty before the first step."""
+        fns = {"fwd": self._fwd, "bwd": self._bwd, "update": self._update}
+        parts = []
+        for s in range(self.S):
+            for which, mult in (
+                ("fwd", self.M), ("bwd", self.M), ("update", 1),
+            ):
+                args = self.cost_args.get((which, s))
+                if args is None:
+                    return []
+                parts.append(
+                    (fns[which][s], args, self.stages[s].devices, mult)
+                )
+        return parts
+
+    def optimizer_state_bytes(self) -> dict:
+        """Summed per-stage optimizer books (``parallel.fsdp``'s
+        analytic accounting): what one device of each stage holds, and
+        the replicated-equivalent total."""
+        from multidisttorch_tpu.parallel.fsdp import optimizer_state_bytes
+
+        per_dev = 0
+        total = 0
+        for st in self.states:
+            b = optimizer_state_bytes(st)
+            per_dev += b["per_device_bytes"]
+            total += b["total_bytes"]
+        return {"per_device_bytes": per_dev, "total_bytes": total}
+
+
+def make_mpmd_reference_step(
+    trial,
+    stage_fns: Sequence[Callable],
+    last_fn: Callable,
+    tx,
+    *,
+    microbatches: int,
+):
+    """The single-mesh parity anchor for an MPMD pipeline: the SAME
+    stage chain and the SAME per-microbatch keys, composed into one
+    jitted step on one submesh with scan-based gradient accumulation
+    (``train.steps.accumulate_gradients`` — ascending-microbatch
+    summation, the pipeline's order). ``bench.py --pipeline`` gates the
+    pipelined trial's losses against this step's.
+
+    Returns ``step(state, batch, rng) -> (state, {"loss_sum"})`` with
+    the driver's metric contract (summed loss over the batch).
+    """
+    import optax
+
+    from multidisttorch_tpu.train.steps import (
+        TrainState,
+        accumulate_gradients,
+    )
+
+    M = int(microbatches)
+
+    def micro_loss(params, mb_batch, mb_rng):
+        acts = (mb_batch,)
+        for fn in stage_fns:
+            acts = fn(params, acts, mb_rng)
+        return last_fn(params, acts)
+
+    def step_fn(state: TrainState, batch, rng):
+        n = batch.shape[0]
+        if M == 1:
+            loss, grads = jax.value_and_grad(micro_loss)(
+                state.params, batch, rng
+            )
+        else:
+            loss, _, grads = accumulate_gradients(
+                trial,
+                lambda p, mbb, r: (micro_loss(p, mbb, r), ()),
+                state.params,
+                (batch,),
+                (jax.random.split(rng, M),),
+                grad_accum=M,
+            )
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=new_params, opt_state=new_opt, step=state.step + 1
+        )
+        return new_state, {"loss_sum": (loss * n).astype(jnp.float32)}
+
+    repl = trial.replicated_sharding
+    return jax.jit(
+        step_fn,
+        in_shardings=(repl, trial.batch_sharding, repl),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )

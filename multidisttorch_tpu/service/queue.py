@@ -315,19 +315,25 @@ class SubmissionQueue:
         lanes: int,
         stacked: bool,
         resumed: bool,
+        blocks=None,
     ) -> None:
-        self.append(
-            {
-                "event": "placed",
-                "submission_id": sub_id,
-                "trial_id": trial_id,
-                "start": start,
-                "size": size,
-                "lanes": lanes,
-                "stacked": stacked,
-                "resumed": resumed,
-            }
-        )
+        rec = {
+            "event": "placed",
+            "submission_id": sub_id,
+            "trial_id": trial_id,
+            "start": start,
+            "size": size,
+            "lanes": lanes,
+            "stacked": stacked,
+            "resumed": resumed,
+        }
+        if blocks is not None:
+            # Vector (MPMD pipelined) placement: the all-or-nothing
+            # per-stage block list — evidence the bench's placement
+            # gate reads. Absent for classic placements, so old
+            # records parse byte-identically.
+            rec["blocks"] = [[int(s), int(n)] for s, n in blocks]
+        self.append(rec)
 
     def unplaced(self, sub_id: str, *, trial_id: int, reason: str) -> None:
         """The trial came off its submesh WITHOUT settling (graceful

@@ -203,6 +203,13 @@ class _Active:
     construct_s: float
     first_step_done: bool = False
     tenants: tuple = ()
+    # Vector (MPMD pipelined) placement: one (start, size) block per
+    # stage. None for classic placements; when set, start/size hold
+    # the first block / the total and freeing walks every block.
+    blocks: Optional[list] = None
+
+    def free_blocks(self) -> list:
+        return list(self.blocks) if self.blocks else [(self.start, self.size)]
 
     def movable(self) -> bool:
         """Defrag victim eligibility, decided at PLAN time: single
@@ -215,6 +222,11 @@ class _Active:
         checkpoint exists OR the trial has made no optimizer step —
         nothing to lose)."""
         if self.stacked:
+            return False
+        if self.blocks is not None and len(self.blocks) > 1:
+            # A pipelined trial occupies several blocks with live
+            # inter-stage transfer edges; migrating one stage would
+            # strand the others mid-schedule. Defrag routes around it.
             return False
         run = self.run
         t = getattr(run, "_ckpt_thread", None)
@@ -522,7 +534,36 @@ class SweepService:
         from multidisttorch_tpu.models.vae import VAE
 
         cfg = self._config_from(sub, trial_id)
-        if cfg is None or sub.size > self.n_slices:
+        if cfg is None:
+            return None
+        # MPMD pipelined configs are VECTOR requests: one block of
+        # `sub.size` slices per stage, placed all-or-nothing; the
+        # fair-share charge and capacity checks use the TOTAL.
+        stages = int(getattr(cfg, "pipeline_stages", 1) or 1)
+        if stages < 1:
+            return None
+        if stages > 1:
+            # Everything the pipelined runner would raise on must be
+            # rejected HERE with a verdict — a deterministic config
+            # error placed anyway classifies INFRA and burns the whole
+            # retry budget re-allocating multi-block placements:
+            # unsupported knobs, a stage count the executing (VAE,
+            # 2-stage) runner doesn't cover, and microbatch shapes
+            # that don't divide over a stage submesh.
+            if cfg.eval_sampled or cfg.fused_steps != 1 or cfg.remat:
+                return None
+            if stages != 2:
+                return None
+            m = max(1, cfg.grad_accum)
+            if cfg.batch_size % m:
+                return None
+            if (cfg.batch_size // m) % (
+                sub.size * self._devs_per_slice
+            ):
+                return None
+        sizes = tuple([sub.size] * stages) if stages > 1 else None
+        total_slices = sub.size * stages
+        if total_slices > self.n_slices:
             return None
         # Per-submission dataset: a cheap shape PROBE at admission
         # (builtin = analytic, file = npz header, cas = store meta) —
@@ -558,12 +599,16 @@ class SweepService:
             priority=sub.priority,
             cfg=cfg,
             bucket=bucket,
-            size=sub.size,
-            cost=float(predicted_cost(cfg, rows) * sub.size),
+            size=total_slices,
+            # The fair-share currency: predicted steps × TOTAL slices
+            # — a pipelined trial is charged the SUM of its stage
+            # blocks (the vtime fix the share property test pins).
+            cost=float(predicted_cost(cfg, rows) * total_slices),
             submit_ts=sub.submit_ts,
             trial_id=trial_id,
             data_sig=dsig,
             resume_scan=resume_scan,
+            sizes=sizes,
         )
 
     def _admit(self, sub: squeue.Submission) -> None:
@@ -677,6 +722,15 @@ class SweepService:
         miss and an inline compile at placement)."""
         if self._farm is None:
             return
+        if entry.sizes is not None or getattr(
+            entry.cfg, "zero_update", False
+        ):
+            # Pipelined trials compile their per-stage programs through
+            # the registry at first step (pipe_* kinds); zero_update
+            # trials pin sharded-state layouts the single-path program
+            # vocabulary doesn't describe. Neither takes a farm
+            # executable — warming would compile programs nobody runs.
+            return
         try:
             start = next(
                 (
@@ -697,12 +751,114 @@ class SweepService:
 
     # -- placement ----------------------------------------------------
 
+    def _start_pipeline_placement(self, p: Placement) -> None:
+        """A vector placement becomes one MPMD pipelined trial: stage
+        submeshes carved from the all-or-nothing block list, driven by
+        ``hpo.pipeline_run._PipelineTrialRun`` under the same
+        cooperative-generator supervision as every other placement."""
+        from multidisttorch_tpu.hpo.driver import data_shape_sig
+        from multidisttorch_tpu.hpo.pipeline_run import _PipelineTrialRun
+
+        t0 = time.perf_counter()
+        now = time.time()
+        e = p.members[0]
+        blocks = list(p.blocks or [])
+
+        def free_all():
+            for st, sz in blocks:
+                self.pool.free(st, sz)
+
+        data = self.train_data
+        spec = self._data_spec(e)
+        if spec:
+            try:
+                data = self._take_dataset(spec)
+                got = data_shape_sig(data, e.cfg.batch_size)
+                if e.data_sig is not None and got != e.data_sig:
+                    raise ValueError(
+                        f"dataset {spec!r} changed shape class since "
+                        f"admission: probed {e.data_sig}, resolved {got}"
+                    )
+            except Exception as exc:  # noqa: BLE001
+                free_all()
+                self._setup_failed([e], exc)
+                return
+        self.attempts[e.trial_id] = self.attempts.get(e.trial_id, 0) + 1
+        self.ledger.attempt_start(
+            e.trial_id, self.chashes[e.trial_id], self.attempts[e.trial_id]
+        )
+        try:
+            stage_meshes = [
+                self._mesh_for(start, size) for start, size in blocks
+            ]
+            run = _PipelineTrialRun(
+                stage_meshes,
+                e.cfg,
+                data,
+                self.test_data,
+                self.service_dir,
+                save_checkpoint=self.save_checkpoints,
+                verbose=self.verbose,
+                resume="scan" if e.resume_scan else False,
+                ckpt_keep_last=self.ckpt_keep_last,
+                attempt=self.attempts[e.trial_id],
+            )
+        except Exception as exc:  # noqa: BLE001 — setup isolation
+            free_all()
+            self._setup_failed([e], exc)
+            return
+        ap = _Active(
+            placement_id=p.placement_id,
+            start=p.start,
+            size=p.size,
+            stacked=False,
+            run=run,
+            gen=run.run(),
+            entries={e.trial_id: e},
+            place_ts=now,
+            construct_s=time.perf_counter() - t0,
+            tenants=(e.tenant,),
+            blocks=blocks,
+        )
+        self.active[p.placement_id] = ap
+        if e.sub_id in self._defrag_targets:
+            self._defrag_targets.discard(e.sub_id)
+            self._defrag_unblocked.append(e.sub_id)
+        self.queue_wait.observe(max(0.0, now - e.submit_ts))
+        self.queue.placed(
+            e.sub_id,
+            trial_id=e.trial_id,
+            start=p.start,
+            size=p.size,
+            lanes=1,
+            stacked=False,
+            resumed=e.resume_scan,
+            blocks=blocks,
+        )
+        _emit(
+            "trial_placed",
+            trial_id=e.trial_id,
+            group_id=p.start,
+            sub_id=e.sub_id,
+            tenant=e.tenant,
+            start=p.start,
+            size=p.size,
+            lanes=1,
+            stacked=False,
+            pipelined=True,
+            blocks=[[int(s), int(n)] for s, n in blocks],
+            queue_wait_s=round(max(0.0, now - e.submit_ts), 4),
+        )
+
     def _start_placement(self, p: Placement) -> None:
         from multidisttorch_tpu.hpo.driver import (
             _StackedBucketRun,
             _TrialRun,
         )
 
+        if p.blocks is not None:
+            self._start_pipeline_placement(p)
+            return
         t0 = time.perf_counter()
         now = time.time()
         mesh = self._mesh_for(p.start, p.size)
@@ -940,7 +1096,8 @@ class SweepService:
 
     def _retire(self, ap: _Active) -> None:
         del self.active[ap.placement_id]
-        self.pool.free(ap.start, ap.size)
+        for start, size in ap.free_blocks():
+            self.pool.free(start, size)
 
     def _step_actives(self) -> bool:
         """One cooperative dispatch per live placement; returns whether
@@ -1117,13 +1274,17 @@ class SweepService:
                 # completions do. Defrag would be pure churn.
                 continue
             blocks = [
+                # A pipelined placement contributes one (immovable)
+                # record per stage block — the planner must see every
+                # slice it occupies, not just the first stage's.
                 PlacedBlock(
                     placement_id=pid,
-                    start=ap.start,
-                    size=ap.size,
+                    start=bstart,
+                    size=bsize,
                     movable=ap.movable(),
                 )
                 for pid, ap in self.active.items()
+                for bstart, bsize in ap.free_blocks()
             ]
             plan = plan_defrag(
                 self.pool, blocks, starved.size
@@ -1274,7 +1435,8 @@ class SweepService:
                 ap.gen.close()
             except Exception:  # noqa: BLE001
                 pass
-            self.pool.free(ap.start, ap.size)
+            for start, size in ap.free_blocks():
+                self.pool.free(start, size)
             self._record_unplaced(ap, reason=reason)
         self.write_books()
 

@@ -71,12 +71,17 @@ class PendingTrial:
 
     ``cfg`` and ``bucket`` are opaque to the scheduler (the runtime
     supplies a TrialConfig and its stack-bucket key); ``cost`` is the
-    trial's predicted work (optimizer steps x size) — the DRR
-    currency. ``resume_scan`` marks a trial that must restore from
+    trial's predicted work (optimizer steps x TOTAL slices — for a
+    multi-slice pipelined trial the sum of its stage slices, so a
+    2-stage whale is charged both blocks' worth of virtual time, never
+    a shrimp's). ``resume_scan`` marks a trial that must restore from
     checkpoint (recovered after a crash, or migrated by defrag): such
     trials never co-pack (stacked lanes cannot restore mid-trial) and
     ``pinned_start`` asks for a specific slice block (a defrag
-    target)."""
+    target). ``sizes`` non-None makes this a VECTOR request (an MPMD
+    pipelined trial: one block per stage, placed all-or-nothing —
+    docs/SERVICE.md); ``size`` then holds the total for capacity
+    checks."""
 
     sub_id: str
     tenant: str
@@ -100,6 +105,10 @@ class PendingTrial:
     # enforced by the runtime's ``can_start`` veto, so a backing-off
     # entry never blocks its tenant's other work.
     not_before: float = 0.0
+    # Per-stage slice sizes of a VECTOR (MPMD pipelined) request, or
+    # None for the classic single-block trial. Placed all-or-nothing;
+    # never co-packed.
+    sizes: Optional[tuple] = None
 
 
 @dataclass
@@ -117,6 +126,10 @@ class Placement:
     size: int
     start: int
     members: list = field(default_factory=list)  # [PendingTrial, ...]
+    # Vector (pipelined) placement: one (start, size) block per stage,
+    # in stage order. None for classic single-block placements; when
+    # set, ``start``/``size`` hold the first block / the total.
+    blocks: Optional[list] = None  # [(start, size), ...] | None
 
     @property
     def lanes(self) -> int:
@@ -181,6 +194,36 @@ class SlicePool:
                 self._mark(start, size, free=False)
                 return start
         return None
+
+    def alloc_multi(self, sizes) -> Optional[list[int]]:
+        """All-or-nothing multi-block allocation for a vector (MPMD
+        pipelined) request: one contiguous block per stage size, or
+        None with the pool UNTOUCHED.
+
+        Deadlock-free ordering: blocks are claimed largest-first
+        (ties by stage order) so a big stage can never be squeezed out
+        by its own trial's small stages landing first — the analog of
+        ordered lock acquisition; combined with all-or-nothing rollback
+        two racing vector requests cannot deadlock the pool, only fail
+        cleanly and retry. Returns starts in STAGE order.
+        """
+        sizes = [int(s) for s in sizes]
+        if not sizes or any(s < 1 for s in sizes):
+            raise ValueError(f"bad vector request sizes {sizes}")
+        order = sorted(
+            range(len(sizes)), key=lambda i: (-sizes[i], i)
+        )
+        starts: list[Optional[int]] = [None] * len(sizes)
+        claimed: list[tuple[int, int]] = []
+        for i in order:
+            got = self.alloc(sizes[i])
+            if got is None:
+                for st, sz in claimed:
+                    self.free(st, sz)
+                return None
+            starts[i] = got
+            claimed.append((got, sizes[i]))
+        return [int(s) for s in starts]  # type: ignore[arg-type]
 
     def alloc_at(self, start: int, size: int) -> bool:
         """Claim the exact block ``[start, start+size)`` if wholly free."""
@@ -406,6 +449,29 @@ class FairShareScheduler:
             pinned = entry.pinned_start is not None
             if can_start is not None and not can_start(entry):
                 continue
+            if entry.sizes is not None:
+                # Vector (pipelined) request: all-or-nothing multi-
+                # block allocation, never co-packed, never pinned
+                # (pipelined placements are defrag-immovable).
+                starts = pool.alloc_multi(entry.sizes)
+                if starts is None:
+                    if entry.blocked_since is None:
+                        entry.blocked_since = now
+                    continue
+                placement = Placement(
+                    placement_id=self._next_placement_id,
+                    bucket=entry.bucket,
+                    size=sum(entry.sizes),
+                    start=starts[0],
+                    blocks=list(zip(starts, entry.sizes)),
+                )
+                self._next_placement_id += 1
+                placements.append(placement)
+                placement.members.append(entry)
+                q.pop(idx)
+                entry.blocked_since = None
+                self._charge(entry, contended)
+                return True
             pack_key = (entry.bucket, entry.size)
             open_p = open_placements.get(pack_key)
             attach = (
@@ -449,20 +515,28 @@ class FairShareScheduler:
             q.pop(idx)
             entry.blocked_since = None
             if not pinned:
-                v = self._vsrv.get(tenant, 0.0)
-                self._vtime = max(self._vtime, v)
-                self._vsrv[tenant] = (
-                    v + entry.cost / self.policy(tenant).weight
-                )
-                self.placed_cost[tenant] = (
-                    self.placed_cost.get(tenant, 0.0) + entry.cost
-                )
-                if contended:
-                    self.contended_cost[tenant] = (
-                        self.contended_cost.get(tenant, 0.0) + entry.cost
-                    )
+                self._charge(entry, contended)
             return True
         return False
+
+    def _charge(self, entry: PendingTrial, contended: bool) -> None:
+        """Advance the tenant's virtual time by the placement's cost.
+        ``entry.cost`` is predicted steps × TOTAL slices — a vector
+        (pipelined) entry's cost already sums its stage blocks, so a
+        2-stage whale pays for both submeshes it occupies (the
+        fair-share property test pins the ±10% bound with mixed
+        single/vector traffic)."""
+        tenant = entry.tenant
+        v = self._vsrv.get(tenant, 0.0)
+        self._vtime = max(self._vtime, v)
+        self._vsrv[tenant] = v + entry.cost / self.policy(tenant).weight
+        self.placed_cost[tenant] = (
+            self.placed_cost.get(tenant, 0.0) + entry.cost
+        )
+        if contended:
+            self.contended_cost[tenant] = (
+                self.contended_cost.get(tenant, 0.0) + entry.cost
+            )
 
     # -- starvation ---------------------------------------------------
 

@@ -311,6 +311,82 @@ def record_step_cost(
     return rec
 
 
+def record_pipeline_cost(
+    key: str,
+    parts,
+    *,
+    devices: Sequence = (),
+    trial_id: Optional[int] = None,
+    group_id: Optional[int] = None,
+) -> Optional[dict]:
+    """Cost books for an MPMD pipelined trial: one optimizer step spans
+    SEVERAL per-stage programs on DIFFERENT submeshes, so the per-step
+    FLOPs book is the weighted sum over ``parts`` — each a ``(fn, args,
+    stage_devices, per_step_multiplier)`` tuple (forward/backward run
+    once per microbatch, the update once). Stored under ``key`` with
+    the same gauge/event shape as :func:`record_step_cost` so
+    :func:`device_books` joins it with the pipeline's step series
+    unchanged: MFU on a backend with a peak table, explicit
+    null-with-reason on CPU. Any stage whose analysis fails degrades
+    the whole book to the recorded reason (a partial sum would be a
+    made-up number)."""
+    reg = get_registry()
+    if reg is None:
+        return None
+    flops: Optional[float] = 0.0
+    bytes_: Optional[float] = 0.0
+    reason = None
+    for fn, args, stage_devices, mult in parts:
+        ca = compiled_cost_analysis(fn, args)
+        if ca["flops"] is None:
+            flops, bytes_, reason = None, None, ca["reason"]
+            break
+        nd = max(1, len(stage_devices))
+        flops += ca["flops"] * nd * float(mult)
+        if ca["bytes_accessed"] is None:
+            # One stage without a bytes book voids the whole sum — a
+            # partial total would read as the pipeline's bandwidth.
+            bytes_ = None
+        elif bytes_ is not None:
+            bytes_ += ca["bytes_accessed"] * nd * float(mult)
+    d0 = devices[0] if devices else None
+    device_kind = getattr(d0, "device_kind", "") or ""
+    platform = getattr(d0, "platform", "") or ""
+    peak = peak_flops_per_chip(device_kind)
+    peak_bw = peak_membw_per_chip(device_kind)
+    n_dev = max(1, len(devices))
+    rec = {
+        "key": key,
+        "steps": 1,
+        "lanes": 1,
+        "devices": n_dev,
+        "device_kind": device_kind,
+        "platform": platform,
+        "flops_per_lane_step": flops,
+        "bytes_per_lane_step": bytes_,
+        "peak_flops_per_chip": peak,
+        "peak_membw_per_chip": peak_bw,
+        "reason": reason,
+    }
+    reg.counter("device_cost_records").inc()
+    reg.gauge("device_lanes", key=key).set(1)
+    reg.gauge("device_mesh_devices", key=key).set(n_dev)
+    if flops is not None:
+        reg.gauge("device_flops_per_lane_step", key=key).set(flops)
+    if bytes_ is not None:
+        reg.gauge("device_bytes_per_lane_step", key=key).set(bytes_)
+    if peak is not None:
+        reg.gauge("device_peak_flops_per_chip", key=key).set(peak)
+    if peak_bw is not None:
+        reg.gauge("device_peak_membw_per_chip", key=key).set(peak_bw)
+    bus = get_bus()
+    if bus is not None:
+        bus.emit(
+            "device_cost", trial_id=trial_id, group_id=group_id, **rec
+        )
+    return rec
+
+
 def _live_buffer_bytes(devices: Sequence) -> Optional[int]:
     """Committed live-array bytes on ``devices`` — the CPU-grade stand-in
     for an allocator watermark: what the process is *holding*, summed

@@ -542,6 +542,29 @@ class SweepFold:
         if ev.get("world") is not None:
             t["world"] = ev["world"]
         data = ev.get("data") or {}
+        if kind == "optimizer_state":
+            # Memory books (docs/PARALLEL.md): the analytic per-device
+            # optimizer footprint — the ZeRO win's run_summary /
+            # sweep_top surface, CPU included.
+            if data.get("per_device_bytes") is not None:
+                t["optimizer_state_bytes"] = int(data["per_device_bytes"])
+            if data.get("zero_update"):
+                t["zero_update"] = True
+        elif kind == "pipeline_start":
+            t["pipeline"] = {
+                "stages": data.get("stages"),
+                "microbatches": data.get("microbatches"),
+                "stage_groups": data.get("stage_groups"),
+                "analytic_bubble": data.get("analytic_bubble"),
+            }
+        elif kind == "pipeline_epoch":
+            p = t.setdefault("pipeline", {})
+            p["measured_bubble"] = data.get("measured_bubble")
+            p["analytic_bubble"] = data.get("analytic_bubble")
+            p["transfer_bytes"] = (
+                int(p.get("transfer_bytes") or 0)
+                + int(data.get("transfer_bytes") or 0)
+            )
         if kind == "attempt_start":
             t["attempts"] = max(t["attempts"], int(ev.get("attempt") or 0))
             t["status"] = "in_flight"

@@ -186,6 +186,15 @@ def render(state: SweepFold, path: str) -> str:
                 f"{in_frac * 100:.1f}%" if in_frac is not None else "-",
                 fmt_mfu(live_mfu(state, tid, rate)),
                 fmt_bytes(book.get("peak_bytes")),
+                # Analytic per-device optimizer bytes (memory books,
+                # docs/PARALLEL.md): the ZeRO win, CPU included; "z"
+                # marks the sharded-update mode.
+                (
+                    fmt_bytes(t["optimizer_state_bytes"])
+                    + ("z" if t.get("zero_update") else "")
+                    if t.get("optimizer_state_bytes") is not None
+                    else "-"
+                ),
                 t.get("anomalies", 0) or "-",
                 (
                     f"{t['admission_s']:.2f}s"
@@ -201,8 +210,8 @@ def render(state: SweepFold, path: str) -> str:
             rows,
             ["trial", "status", "att", "epoch", "steps", "step rate",
              "train loss", "test loss", "retries", "faults", "lane",
-             "in%", "mfu", "peak mem", "anom", "admit", "compile",
-             "wall"],
+             "in%", "mfu", "peak mem", "opt mem", "anom", "admit",
+             "compile", "wall"],
         )
     )
     if state.input:
