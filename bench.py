@@ -2133,6 +2133,20 @@ def main():
         "artifacts/bench_pipeline_*.json)",
     )
     parser.add_argument(
+        "--fabric", action="store_true",
+        help="run the service-fabric acceptance drill (docs/SERVICE.md "
+        "\"Service fabric\"): 2 replica daemons, one SIGKILLed with "
+        "work outstanding — the survivor adopts the orphaned shard "
+        "through a lease-fenced epoch claim with zero lost submissions "
+        "and bit-identical re-homed trials; a deadline trial "
+        "checkpoint-drain preempts best-effort lanes within the "
+        "anti-thrash budget; and a 1M-submission discrete-event "
+        "loadgen replay against the pure scheduler core (p99 "
+        "placement latency, fairness <= 10%, deadline hit rate, "
+        "churn; MDT_FABRIC_LOADGEN_N overrides the count; banks "
+        "artifacts/bench_fabric_*.json)",
+    )
+    parser.add_argument(
         "--suite", action="store_true",
         help="bank every measurement (flagship, fused-loss comparison, "
         "LM, to-elbo, loader) in one process — for one-shot windows on "
@@ -2145,14 +2159,15 @@ def main():
                      args.lm, args.suite, args.decode, args.stacked,
                      args.chaos, args.chaos_mh, args.coldstart,
                      args.pbt, args.service, args.dataplane,
-                     args.pipeline)) > 1:
+                     args.pipeline, args.fabric)) > 1:
         parser.error("--concurrency/--to-elbo/--loader/--lm/--decode/"
                      "--suite/--stacked/--chaos/--chaos-mh/--coldstart/"
-                     "--pbt/--service/--dataplane/--pipeline are "
-                     "mutually exclusive")
+                     "--pbt/--service/--dataplane/--pipeline/--fabric "
+                     "are mutually exclusive")
 
     if (args.stacked or args.chaos or args.chaos_mh or args.pbt
-            or args.service or args.dataplane or args.pipeline) and \
+            or args.service or args.dataplane or args.pipeline
+            or args.fabric) and \
             "xla_force_host_platform_device_count" not in (
         os.environ.get("XLA_FLAGS", "")
     ):
@@ -2540,6 +2555,71 @@ def main():
                         r["pipelined"]["input_bound_frac"],
                     ],
                     "ok": all(r["gates"].values()),
+                    "banked_as": banked,
+                    "detail": r,
+                }
+            )
+        )
+        return
+
+    if args.fabric:
+        import tempfile
+
+        from multidisttorch_tpu.service.fabric_drill import (
+            run_fabric_bench,
+        )
+
+        r = run_fabric_bench(tempfile.mkdtemp(prefix="bench_fabric_"))
+        r["backend"] = backend
+        banked = None
+        try:
+            os.makedirs("artifacts", exist_ok=True)
+            stamp = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
+            platform = backend.get("platform", "cpu")
+            banked = f"artifacts/bench_fabric_{platform}_{stamp}.json"
+            tmp = banked + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(r, f, indent=1)
+            os.replace(tmp, banked)
+            latest = "artifacts/bench_fabric_latest.json"
+            with open(latest + ".tmp", "w") as f:
+                json.dump({**r, "banked_as": banked}, f, indent=1)
+            os.replace(latest + ".tmp", latest)
+        except OSError as e:
+            print(f"artifact banking failed: {e!r}", file=sys.stderr)
+            banked = None
+        lg = r["loadgen"]
+        print(
+            json.dumps(
+                {
+                    "metric": "fabric_loadgen_p99_placement_latency_s",
+                    "value": lg["placement_latency_s"].get("p99"),
+                    "unit": "virtual seconds at "
+                    f"{lg['submitted']} submissions (overload "
+                    "regime, pure scheduler core at simulation "
+                    "speed)",
+                    # acceptance: replica SIGKILL with work
+                    # outstanding -> survivor adopts the shard, zero
+                    # lost, re-homed trials bit-identical; deadline
+                    # preemption within the anti-thrash budget; 1M
+                    # loadgen fairness <= 10% + deadline hit rate.
+                    "kill_exercised": r["failover"]["kill_exercised"],
+                    "zero_lost": r["failover"]["zero_lost"],
+                    "rehomed_bit_identical": r["failover"]["parity"][
+                        "bit_identical"
+                    ],
+                    "deadline_drill_ok": r["deadline"]["ok"],
+                    "fairness_max_abs_ratio_error": lg["fairness"][
+                        "max_abs_ratio_error"
+                    ],
+                    "deadline_hit_rate": lg["deadline"]["hit_rate"],
+                    "churn_per_1k_placements": lg["churn"][
+                        "evictions_per_1k_placements"
+                    ],
+                    "submissions_per_wall_s": lg[
+                        "submissions_per_wall_s"
+                    ],
+                    "ok": r["ok"],
                     "banked_as": banked,
                     "detail": r,
                 }

@@ -34,6 +34,7 @@ import numpy as np
 from multidisttorch_tpu.faults.plan import (
     CKPT_CORRUPT,
     CRASH,
+    DAEMON_LOST,
     DATA_ERROR,
     DIVERGE,
     HOST_KINDS,
@@ -219,6 +220,16 @@ class FaultInjector:
             if spec.kind == HOST_LOST:
                 os._exit(HOST_LOST_EXIT_CODE)
                 return  # unreachable live; tests monkeypatch os._exit
+            if spec.kind == DAEMON_LOST:
+                # The fabric drill's replica kill: a REAL SIGKILL (not
+                # os._exit) so the death is indistinguishable from an
+                # operator `kill -9` — no drain, no atexit, shard
+                # leases stop renewing mid-epoch. The fired record
+                # above is already fsync'd.
+                import signal
+
+                os.kill(os.getpid(), signal.SIGKILL)
+                return  # unreachable live; tests monkeypatch os.kill
             assert spec.kind == WEDGE
             from multidisttorch_tpu.parallel import membership
 
@@ -228,6 +239,13 @@ class FaultInjector:
                 f"injected wedge on host {self.host_slot} unwedged after "
                 f"{spec.delay_s:g}s — world presumed re-formed without it"
             )
+
+    def host_step(self, n_steps: int = 1) -> None:
+        """Advance ONLY the host/replica cumulative-dispatch clock (the
+        fabric replica's seam: it has no per-trial step hook — the
+        shard services own those — but its daemon_lost fault must fire
+        on real dispatch progress)."""
+        self._host_hook(n_steps)
 
     def step_hook(self, trial_id: int, step: int, n_steps: int = 1) -> None:
         """Called before dispatching ``n_steps`` optimizer steps starting

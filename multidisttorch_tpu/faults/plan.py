@@ -36,12 +36,19 @@ WEDGE = "wedge"            # the targeted HOST stops making progress
                            # (sleeps with its heartbeat suspended): the
                            # peers' sync watchdogs must convert the
                            # stuck collective into WedgedCollective
+DAEMON_LOST = "daemon_lost"  # SIGKILL the targeted service-fabric
+                             # REPLICA on its cumulative dispatch clock
+                             # (no drain, no cleanup — shard leases go
+                             # stale and a surviving replica must adopt
+                             # the orphaned shard, docs/SERVICE.md)
 
 INFRA_KINDS = frozenset({CRASH, PREEMPT, SLOW, DATA_ERROR, CKPT_CORRUPT})
 # Host-scoped kinds fire on ONE host of a multi-host world (FaultSpec
 # .host), keyed to the host's cumulative dispatched-step count instead
 # of a single trial's step — the fault is about the host, not a trial.
-HOST_KINDS = frozenset({HOST_LOST, WEDGE})
+# DAEMON_LOST reads .host as the fabric REPLICA id (the replica's
+# dispatch clock is the firing clock).
+HOST_KINDS = frozenset({HOST_LOST, WEDGE, DAEMON_LOST})
 ALL_KINDS = INFRA_KINDS | HOST_KINDS | {DIVERGE}
 
 

@@ -24,6 +24,14 @@ service (docs/SERVICE.md):
   driving all of it, exporting scheduling books (per-tenant goodput,
   queue-wait and placement-latency histograms, fragmentation gauge)
   through the telemetry bus; ``tools/sweep_service.py`` is the CLI.
+- :mod:`service.fabric` — the sharded service fabric: N daemon
+  replicas owning tenant shards through epoch-fenced leases, with
+  orphaned shards adopted (journal replay + checkpoint re-homing) by
+  survivors — a replica death is a scheduler event, not an outage.
+- :mod:`service.loadgen` — the discrete-event load generator that
+  replays millions of synthetic submissions against the pure
+  scheduler core at simulation speed (p99 placement latency,
+  fairness error, deadline hit rate, preemption/defrag churn).
 """
 
 from multidisttorch_tpu.service.queue import (  # noqa: F401
@@ -35,10 +43,21 @@ from multidisttorch_tpu.service.queue import (  # noqa: F401
 from multidisttorch_tpu.service.scheduler import (  # noqa: F401
     FairShareScheduler,
     PendingTrial,
+    PreemptionPolicy,
     SlicePool,
     TenantPolicy,
 )
 from multidisttorch_tpu.service.defrag import (  # noqa: F401
     DefragPlan,
+    PreemptPlan,
     plan_defrag,
+    plan_preemption,
+)
+from multidisttorch_tpu.service.fabric import (  # noqa: F401
+    FabricClient,
+    FabricReplica,
+    FenceLost,
+    ShardFence,
+    shard_of,
+    try_claim,
 )

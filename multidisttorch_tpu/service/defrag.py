@@ -148,6 +148,74 @@ def plan_defrag(
     return best[2] if best is not None else None
 
 
+@dataclass
+class PreemptPlan:
+    """Victims to EVICT (not relocate) to open the window for a
+    deadline-tagged trial. Unlike a :class:`DefragPlan`, victims are
+    not re-homed — they checkpoint-drain, ledger ``preempted``, and
+    requeue as best-effort backlog (the fabric's first-class
+    preemption primitive, docs/SERVICE.md "Deadlines")."""
+
+    window_start: int
+    window_size: int
+    victims: list = field(default_factory=list)  # [placement_id, ...]
+    victim_slices: int = 0
+
+
+def plan_preemption(
+    pool: SlicePool,
+    placements: list[PlacedBlock],
+    want_size: int,
+) -> Optional[PreemptPlan]:
+    """Cheapest window openable by EVICTING best-effort placements, or
+    None when every candidate window holds an unevictable one.
+
+    ``placements`` must carry ``movable=True`` only for placements the
+    caller has already cleared for eviction (best-effort, checkpoint
+    flushed, within the anti-thrash budget — the runtime's
+    ``_preemptible`` verdict). Defrag's window scan, minus the re-home
+    feasibility leg: eviction frees the victim's slices outright, so
+    the only cost is the victims' lost progress, minimized as total
+    evicted slice-size (ties: lowest window start)."""
+    n = pool.n_slices
+    if want_size < 1 or want_size > n:
+        return None
+    if pool.largest_free_run() >= want_size:
+        for start, ln in pool.free_runs():
+            if ln >= want_size:
+                return PreemptPlan(window_start=start, window_size=want_size)
+    by_slice: dict[int, PlacedBlock] = {}
+    for p in placements:
+        for i in range(p.start, p.start + p.size):
+            by_slice[i] = p
+    free = set(
+        i for start, ln in pool.free_runs() for i in range(start, start + ln)
+    )
+    best: Optional[PreemptPlan] = None
+    for w0 in range(0, n - want_size + 1):
+        victims: dict[int, PlacedBlock] = {}
+        ok = True
+        for i in range(w0, w0 + want_size):
+            if i in free:
+                continue
+            p = by_slice.get(i)
+            if p is None or not p.movable:
+                ok = False
+                break
+            victims[p.placement_id] = p
+        if not ok or not victims:
+            continue
+        cost = sum(p.size for p in victims.values())
+        if best is None or cost < best.victim_slices:
+            best = PreemptPlan(
+                window_start=w0,
+                window_size=want_size,
+                victims=sorted(victims),
+                victim_slices=cost,
+            )
+    return best
+
+
 def _runs_of(slices: list[int]) -> list[list[int]]:
     """Maximal ascending runs as mutable ``[start, length]`` cells."""
     runs: list[list[int]] = []

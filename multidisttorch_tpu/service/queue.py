@@ -39,6 +39,26 @@ from typing import Optional
 QUEUE_NAME = "queue.jsonl"
 INTAKE_DIR = "intake"
 
+
+def fsync_dir(path: str) -> None:
+    """Flush a directory's entry table (``train/checkpoint.py``'s
+    atomic-write discipline, duplicated here so the queue stays
+    importable without jax): after ``os.replace`` lands a file, the
+    RENAME itself is not durable until the directory is fsync'd — on
+    ext4-ordered (and most journaling filesystems) a crash can roll
+    the directory back and the committed file vanishes. Best-effort:
+    some filesystems refuse O_RDONLY dir fsync."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
 # Submission lifecycle states, in order. ``rejected`` is terminal like
 # ``settled``; ``unplaced`` folds back to ``admitted`` (the trial is
 # queued again — a drain or a defrag migration took it off its submesh).
@@ -60,8 +80,11 @@ class Submission:
     CONTIGUOUS slices — the large-shape case defrag exists for).
     ``priority`` is a lane: 0 is served strictly before 1, which is
     served strictly before 2 (fair-share applies *within* a lane).
-    ``deadline_s`` is advisory metadata surfaced in the books (the
-    scheduler does not kill overdue trials)."""
+    ``deadline_s`` (seconds from submission) EDF-orders the trial
+    inside its tenant's fair share and arms deadline preemption of
+    best-effort lanes within the anti-thrash budget (docs/SERVICE.md
+    "Deadlines"); hits and misses are accounted in the books — the
+    scheduler never kills an overdue trial."""
 
     submission_id: str
     tenant: str
@@ -157,14 +180,11 @@ class SweepClient:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, final)  # the commit point
-        try:  # best-effort dir fsync, like train/checkpoint.py
-            fd = os.open(d, os.O_RDONLY)
-            try:
-                os.fsync(fd)
-            finally:
-                os.close(fd)
-        except OSError:
-            pass
+        # Directory fsync AFTER the rename: without it the commit
+        # point itself can vanish on a crash (the rename sits only in
+        # the page cache). The call sequence — file fsync, rename, dir
+        # fsync — is regression-tested (tests/test_fabric.py).
+        fsync_dir(d)
         return sub.submission_id
 
     def status(self, submission_id: str) -> Optional[dict]:
@@ -225,22 +245,62 @@ class SubmissionQueue:
     service's control state, and a restarted daemon re-folds it to
     recover exactly where the previous incarnation died."""
 
-    def __init__(self, service_dir: str, *, write: bool = True):
+    def __init__(
+        self, service_dir: str, *, write: bool = True, fence=None
+    ):
         self.service_dir = service_dir
         self.path = queue_path(service_dir)
         self.write = write
+        # Shard fence (fabric): raises before any append once this
+        # writer's shard lease was taken over — a stale daemon's
+        # transitions must be REJECTED, never interleaved with the new
+        # owner's journal.
+        self._fence = fence
+        self._tail_checked = False
 
     # -- journal ------------------------------------------------------
+
+    def _terminate_torn_tail(self) -> None:
+        """If the journal's previous writer died mid-append, the file
+        ends without a newline. Appending straight onto that torn line
+        would CONCATENATE the new record into it — one undecodable
+        line swallowing BOTH records (found by the adoption-replay
+        regression test). Checked once per writer: after our own
+        appends the file always ends with a newline."""
+        if self._tail_checked:
+            return
+        self._tail_checked = True
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                if f.tell() == 0:
+                    return
+                f.seek(-1, os.SEEK_END)
+                torn = f.read(1) != b"\n"
+        except OSError:
+            return  # no file yet: nothing to terminate
+        if torn:
+            with open(self.path, "a") as f:
+                f.write("\n")
 
     def append(self, record: dict) -> None:
         if not self.write:
             return
+        if self._fence is not None:
+            self._fence()
         os.makedirs(self.service_dir, exist_ok=True)
+        self._terminate_torn_tail()
         line = json.dumps({**record, "ts": time.time()}, default=str)
+        created = not os.path.exists(self.path)
         with open(self.path, "a") as f:
             f.write(line + "\n")
             f.flush()
             os.fsync(f.fileno())
+        if created:
+            # First-ever append CREATED the journal: the file's
+            # directory entry needs the same durability as the record
+            # (a crash must not vanish the whole queue).
+            fsync_dir(self.service_dir)
 
     def load(self) -> list[dict]:
         return load_queue(self.service_dir)

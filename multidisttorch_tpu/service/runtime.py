@@ -60,12 +60,17 @@ from multidisttorch_tpu.hpo.supervision import (
     classify_failure,
 )
 from multidisttorch_tpu.service import queue as squeue
-from multidisttorch_tpu.service.defrag import PlacedBlock, plan_defrag
+from multidisttorch_tpu.service.defrag import (
+    PlacedBlock,
+    plan_defrag,
+    plan_preemption,
+)
 from multidisttorch_tpu.service.scheduler import (
     ADMIT,
     FairShareScheduler,
     PendingTrial,
     Placement,
+    PreemptionPolicy,
     REJECT_INVALID,
     SlicePool,
     TenantPolicy,
@@ -95,11 +100,23 @@ class TaggedLedger(SweepLedger):
     """A :class:`SweepLedger` that stamps tenant provenance on every
     attempt record from a trial-id → tags map, so the driver-owned
     call sites (``_StackedBucketRun`` ledgers its own lanes) carry the
-    service's multi-tenant identity without knowing about tenants."""
+    service's multi-tenant identity without knowing about tenants.
 
-    def __init__(self, out_dir: str, **kw):
+    ``fence`` (the fabric's shard-ownership check) gates every append:
+    a replica that lost its shard lease must not write one more record
+    to a ledger the new owner now folds — the check raises before the
+    open, so a stale incarnation's appends are REJECTED, never
+    interleaved (docs/SERVICE.md "Fencing")."""
+
+    def __init__(self, out_dir: str, *, fence=None, **kw):
         super().__init__(out_dir, **kw)
         self.tags: dict[int, dict] = {}
+        self._fence = fence
+
+    def append(self, event: dict) -> None:
+        if self._fence is not None:
+            self._fence()
+        super().append(event)
 
     def tag(self, trial_id: int, *, tenant, priority, submit_ts) -> None:
         self.tags[trial_id] = {
@@ -264,6 +281,8 @@ class SweepService:
         starvation_s: float = 3.0,
         defrag_enabled: bool = True,
         defrag_cooldown_s: float = 1.0,
+        preempt: Optional[PreemptionPolicy] = None,
+        fence=None,
         retry: Optional[RetryPolicy] = None,
         save_checkpoints: bool = True,
         ckpt_keep_last: int = 2,
@@ -294,8 +313,14 @@ class SweepService:
             default_policy=default_policy,
             max_total_pending=max_total_pending,
         )
-        self.queue = squeue.SubmissionQueue(service_dir)
-        self.ledger = TaggedLedger(service_dir)
+        # The shard fence (fabric replicas): a zero-arg callable that
+        # raises FenceLost when this service's shard lease was taken
+        # over — checked at every tick and before every durable append,
+        # so a paused-and-resumed replica cannot double-place work the
+        # new owner already re-homed.
+        self._fence = fence
+        self.queue = squeue.SubmissionQueue(service_dir, fence=fence)
+        self.ledger = TaggedLedger(service_dir, fence=fence)
         self.train_data = (
             train_data
             if train_data is not None
@@ -316,6 +341,7 @@ class SweepService:
         self.starvation_s = float(starvation_s)
         self.defrag_enabled = bool(defrag_enabled)
         self.defrag_cooldown_s = float(defrag_cooldown_s)
+        self.preempt = preempt if preempt is not None else PreemptionPolicy()
         self.retry = retry
         self.save_checkpoints = bool(save_checkpoints)
         self.ckpt_keep_last = int(ckpt_keep_last)
@@ -336,6 +362,7 @@ class SweepService:
         self._farm = None
         self._last_books_ts = 0.0
         self._last_defrag_ts = 0.0
+        self._last_preempt_scan = float("-inf")
         self._defrag_count = 0
         self._defrag_moved_slices = 0
         # sub_ids a defrag opened a window FOR (pending verdict) vs
@@ -345,8 +372,21 @@ class SweepService:
         # blocked, and the books must not claim otherwise.
         self._defrag_targets: set = set()
         self._defrag_unblocked: list[str] = []
+        # Deadline/preemption accounting (same placement-time verdict
+        # discipline as defrag: "unblocked" lands when the deadline
+        # trial actually places, never at plan time).
+        self._preempt_targets: set = set()
+        self._preempt_unblocked: list[str] = []
+        self._preempt_events = 0
+        self._preempt_evictions = 0
+        self._preempt_evicted_slices = 0
+        self._deadline_hits = 0
+        self._deadline_misses = 0
         self._frag_max = 0.0
         self._known_ids: set = set()
+        # Cumulative cooperative dispatches across all placements —
+        # the fabric replica's fault clock (daemon_lost fires on it).
+        self.dispatches = 0
         # Incremental books state: a persistent daemon must not
         # re-read its whole append-only journal/ledger history on
         # every books write (O(n²) over the daemon lifetime) — only
@@ -609,6 +649,16 @@ class SweepService:
             data_sig=dsig,
             resume_scan=resume_scan,
             sizes=sizes,
+            # The deadline tag becomes an absolute EDF key: submit
+            # time + the tenant's relative budget. Recovery rebuilds
+            # the SAME deadline_ts from the journaled submission, so a
+            # restarted daemon keeps the original clock, not a fresh
+            # one.
+            deadline_ts=(
+                sub.submit_ts + sub.deadline_s
+                if sub.deadline_s is not None
+                else None
+            ),
         )
 
     def _admit(self, sub: squeue.Submission) -> None:
@@ -821,9 +871,7 @@ class SweepService:
             blocks=blocks,
         )
         self.active[p.placement_id] = ap
-        if e.sub_id in self._defrag_targets:
-            self._defrag_targets.discard(e.sub_id)
-            self._defrag_unblocked.append(e.sub_id)
+        self._note_unblock(e)
         self.queue_wait.observe(max(0.0, now - e.submit_ts))
         self.queue.placed(
             e.sub_id,
@@ -973,11 +1021,7 @@ class SweepService:
         )
         self.active[p.placement_id] = ap
         for e in members:
-            if e.sub_id in self._defrag_targets:
-                # The defrag verdict lands only now: the starved trial
-                # actually got a submesh.
-                self._defrag_targets.discard(e.sub_id)
-                self._defrag_unblocked.append(e.sub_id)
+            self._note_unblock(e)
             self.queue_wait.observe(max(0.0, now - e.submit_ts))
             self.queue.placed(
                 e.sub_id,
@@ -1000,6 +1044,22 @@ class SweepService:
                 stacked=stacked,
                 queue_wait_s=round(max(0.0, now - e.submit_ts), 4),
             )
+
+    def _note_unblock(self, e: PendingTrial) -> None:
+        """Defrag/preemption verdicts land only at PLACEMENT: the
+        starved (or deadline-blocked) trial actually got a submesh —
+        plan-time claims would lie when another tenant steals the
+        opened window. A re-placed eviction victim also restarts its
+        anti-thrash cooldown here (the guarantee is a cooldown of
+        RUNNING time, not queue wait)."""
+        if e.preempt_count > 0:
+            self.preempt.note_replaced(e.trial_id, time.time())
+        if e.sub_id in self._defrag_targets:
+            self._defrag_targets.discard(e.sub_id)
+            self._defrag_unblocked.append(e.sub_id)
+        if e.sub_id in self._preempt_targets:
+            self._preempt_targets.discard(e.sub_id)
+            self._preempt_unblocked.append(e.sub_id)
 
     def _setup_failed(self, members, exc: BaseException) -> None:
         """Setup failed before any lane existed for these members
@@ -1083,13 +1143,33 @@ class SweepService:
         ):
             d.pop(tid, None)
         self._defrag_targets.discard(entry.sub_id)
+        self._preempt_targets.discard(entry.sub_id)
+        self.preempt.forget(tid)
+        now = time.time()
+        if entry.deadline_ts is not None:
+            # The deadline verdict: completed AND settled before the
+            # absolute deadline = hit; a late completion, failure or
+            # divergence = miss. Accounted, never enforced.
+            hit = status == "completed" and now <= entry.deadline_ts
+            if hit:
+                self._deadline_hits += 1
+            else:
+                self._deadline_misses += 1
+            _emit(
+                "deadline_hit" if hit else "deadline_miss",
+                trial_id=tid,
+                sub_id=entry.sub_id,
+                tenant=entry.tenant,
+                status=status,
+                margin_s=round(entry.deadline_ts - now, 3),
+            )
         _emit(
             "submission_settled",
             trial_id=entry.trial_id,
             sub_id=entry.sub_id,
             tenant=entry.tenant,
             status=status,
-            wait_to_settle_s=round(time.time() - entry.submit_ts, 3),
+            wait_to_settle_s=round(now - entry.submit_ts, 3),
         )
 
     # -- stepping -----------------------------------------------------
@@ -1110,6 +1190,7 @@ class SweepService:
             try:
                 next(ap.gen)
                 progressed = True
+                self.dispatches += 1
                 if not ap.first_step_done:
                     ap.first_step_done = True
                     # Placement latency: placement decision → the first
@@ -1321,30 +1402,7 @@ class SweepService:
             ap = self.active.get(pid)
             if ap is None or ap.stacked:
                 continue  # raced a completion; window may open anyway
-            entry = next(iter(ap.entries.values()))
-            tid = entry.trial_id
-            # Checkpoint-drain the victim: close the generator at its
-            # current yield point, land any in-flight checkpoint write,
-            # and record the controlled preemption — the migrated
-            # attempt resumes from its last durable epoch boundary via
-            # the scan-back restore (PR 5's machinery).
-            try:
-                ap.gen.close()
-            except Exception:  # noqa: BLE001 — teardown must go on
-                pass
-            try:
-                ap.run._join_ckpt()
-            except Exception:  # noqa: BLE001
-                pass
-            self.ledger.attempt_end(
-                tid,
-                self.chashes[tid],
-                self.attempts.get(tid, 1),
-                "preempted",
-                error="defrag migration",
-                summary=self._attempt_progress(ap, tid),
-            )
-            self._retire(ap)
+            entry = self._checkpoint_drain(ap, reason="defrag migration")
             # The victim re-enters the queue FRONT, pinned to the
             # planner's relocation target (outside the window); the
             # next scheduling pass serves it first, so it claims its
@@ -1353,7 +1411,7 @@ class SweepService:
             # or the starved trial's own allocation would fail.
             _emit(
                 "defrag_move",
-                trial_id=tid,
+                trial_id=entry.trial_id,
                 sub_id=entry.sub_id,
                 tenant=entry.tenant,
                 src=ap.start,
@@ -1362,7 +1420,7 @@ class SweepService:
             )
             _emit(
                 "trial_migrated",
-                trial_id=tid,
+                trial_id=entry.trial_id,
                 src_group=ap.start,
                 dst_group=new_start,
                 reason="defrag",
@@ -1389,6 +1447,172 @@ class SweepService:
             fragmentation_after=round(self.pool.fragmentation(), 4),
             wall_s=round(time.perf_counter() - t0, 4),
         )
+
+    # -- deadline preemption ------------------------------------------
+
+    def _checkpoint_drain(self, ap: _Active, *, reason: str) -> PendingTrial:
+        """The first-class preemption primitive (defrag's move and the
+        deadline eviction share it): close the victim's generator at
+        its current yield point, land any in-flight checkpoint write,
+        ledger the attempt ``preempted``, and retire the placement —
+        the caller decides where (and whether pinned) the entry
+        requeues. The victim resumes from its last durable epoch
+        boundary via the scan-back restore (PR 5's machinery)."""
+        entry = next(iter(ap.entries.values()))
+        tid = entry.trial_id
+        try:
+            ap.gen.close()
+        except Exception:  # noqa: BLE001 — teardown must go on
+            pass
+        try:
+            ap.run._join_ckpt()
+        except Exception:  # noqa: BLE001
+            pass
+        self.ledger.attempt_end(
+            tid,
+            self.chashes[tid],
+            self.attempts.get(tid, 1),
+            "preempted",
+            error=reason,
+            summary=self._attempt_progress(ap, tid),
+        )
+        self._retire(ap)
+        return entry
+
+    def _preemptible(self, ap: _Active, now: float) -> bool:
+        """May this placement be EVICTED for a deadline right now?
+        Best-effort only (a deadline trial never evicts another
+        deadline trial — EDF already ordered them), checkpoint-drained
+        safely (``movable``: single, durable checkpoint or nothing to
+        lose), and within the anti-thrash budget."""
+        if not ap.movable():
+            return False
+        for tid, entry in ap.entries.items():
+            if entry.deadline_ts is not None:
+                return False
+            if not self.preempt.victim_allowed(
+                tid, entry.preempt_count, now
+            ):
+                return False
+        return True
+
+    def _maybe_preempt(self, now: float) -> None:
+        """Deadline-driven preemption, at most one event per global
+        cooldown: the earliest-deadline pending entry that cannot fit
+        in any free run may evict best-effort placements (cheapest
+        window, :func:`plan_preemption`) — drained through the same
+        checkpoint-drain primitive as defrag, requeued to the
+        best-effort backlog, verdict recorded at the deadline trial's
+        actual placement."""
+        if not self.active or not self.preempt.event_allowed(now):
+            return
+        # The global cooldown throttles the SCAN, not just successful
+        # events: deadline_pending walks and sorts every pending entry,
+        # which the hot cooperative loop must not pay per tick while
+        # no eviction ever fires (event_allowed stays True until the
+        # first one).
+        if now - self._last_preempt_scan < self.preempt.global_cooldown_s:
+            return
+        self._last_preempt_scan = now
+        # One blocks build per scan: the movable/budget verdicts
+        # cannot change between candidates (the method returns after
+        # the first eviction event), so per-candidate rebuilds would
+        # be O(candidates x placements) for nothing.
+        blocks = None
+        blocked_emitted = False
+        for starved in self.sched.deadline_pending(now=now):
+            if starved.sizes is not None:
+                # Vector (pipelined) deadline requests place through
+                # normal EDF order only — evicting several windows at
+                # once is more churn than the budget is worth.
+                continue
+            if starved.not_before > now:
+                continue  # backing off — its own retry clock rules
+            if starved.deadline_ts - now > self.preempt.urgency_s:
+                continue  # plenty of slack: wait the EDF turn instead
+            if self.pool.can_fit(starved.size):
+                continue  # placeable already; EDF order will serve it
+            if blocks is None:
+                blocks = [
+                    PlacedBlock(
+                        placement_id=pid,
+                        start=bstart,
+                        size=bsize,
+                        movable=self._preemptible(ap, now),
+                    )
+                    for pid, ap in self.active.items()
+                    for bstart, bsize in ap.free_blocks()
+                ]
+            plan = plan_preemption(self.pool, blocks, starved.size)
+            if plan is None:
+                if not blocked_emitted:
+                    # One blocked event per scan: a persistently
+                    # infeasible deadline backlog must not flood the
+                    # bus every cooldown window.
+                    blocked_emitted = True
+                    _emit(
+                        "preempt_blocked",
+                        sub_id=starved.sub_id,
+                        tenant=starved.tenant,
+                        want_size=starved.size,
+                        deadline_in_s=round(
+                            starved.deadline_ts - now, 3
+                        ),
+                        reason="no evictable window (deadline/"
+                        "immovable placements or anti-thrash budget "
+                        "exhausted)",
+                    )
+                continue
+            _emit(
+                "preempt_start",
+                sub_id=starved.sub_id,
+                trial_id=starved.trial_id,
+                tenant=starved.tenant,
+                want_size=starved.size,
+                deadline_in_s=round(starved.deadline_ts - now, 3),
+                victims=list(plan.victims),
+            )
+            evicted = 0
+            for pid in plan.victims:
+                ap = self.active.get(pid)
+                if ap is None or not self._preemptible(ap, now):
+                    continue  # raced a completion/checkpoint start
+                entry = self._checkpoint_drain(
+                    ap,
+                    reason=(
+                        f"deadline preemption for {starved.sub_id}"
+                    ),
+                )
+                entry.preempt_count += 1
+                self.preempt.note_eviction(entry.trial_id, now)
+                self._preempt_evictions += 1
+                self._preempt_evicted_slices += ap.size
+                evicted += ap.size
+                _emit(
+                    "preempt_victim",
+                    trial_id=entry.trial_id,
+                    sub_id=entry.sub_id,
+                    tenant=entry.tenant,
+                    start=ap.start,
+                    size=ap.size,
+                    preempt_count=entry.preempt_count,
+                    for_sub_id=starved.sub_id,
+                )
+                # Victims rejoin the best-effort backlog (EDF keeps
+                # them behind every deadline) and resume from their
+                # drained checkpoint on their next placement.
+                self._requeue(entry, reason="deadline preemption")
+            self._preempt_events += 1
+            self._preempt_targets.add(starved.sub_id)
+            self.preempt.last_event_ts = now
+            _emit(
+                "preempt_end",
+                sub_id=starved.sub_id,
+                want_size=starved.size,
+                evicted_slices=evicted,
+                freed_contiguous=self.pool.largest_free_run(),
+            )
+            return  # one preemption event per cooldown window
 
     # -- drain / books ------------------------------------------------
 
@@ -1512,6 +1736,33 @@ class SweepService:
                 "unblocked": list(self._defrag_unblocked),
                 "pending_unblock": sorted(self._defrag_targets),
             },
+            "preemption": {
+                "events": self._preempt_events,
+                "evictions": self._preempt_evictions,
+                "evicted_slices": self._preempt_evicted_slices,
+                "unblocked": list(self._preempt_unblocked),
+                "pending_unblock": sorted(self._preempt_targets),
+                "policy": {
+                    "max_per_trial": self.preempt.max_preemptions_per_trial,
+                    "trial_cooldown_s": self.preempt.trial_cooldown_s,
+                    "global_cooldown_s": self.preempt.global_cooldown_s,
+                    "enabled": self.preempt.enabled,
+                },
+            },
+            "deadline": {
+                "hits": self._deadline_hits,
+                "misses": self._deadline_misses,
+                "hit_rate": (
+                    round(
+                        self._deadline_hits
+                        / (self._deadline_hits + self._deadline_misses),
+                        4,
+                    )
+                    if (self._deadline_hits + self._deadline_misses)
+                    else None
+                ),
+                "pending": len(self.sched.deadline_pending()),
+            },
             "dataset_cache": self.store.stats(),
         }
 
@@ -1530,6 +1781,11 @@ class SweepService:
         caller's idle-sleep signal). Factored out of :meth:`serve` so
         tests can single-step the daemon deterministically."""
         now = time.time()
+        if self._fence is not None:
+            # One fence check per tick, BEFORE any placement or
+            # journal write: a replica that lost its shard lease must
+            # observe it here and stop, not discover it mid-append.
+            self._fence()
         fresh = self.queue.drain_intake(known_ids=self._known_ids)
         for sub in fresh:
             _emit(
@@ -1551,6 +1807,7 @@ class SweepService:
         for p in placements:
             self._start_placement(p)
         progressed = self._step_actives()
+        self._maybe_preempt(now)
         self._maybe_defrag(now)
         if now - self._last_books_ts >= self.books_every_s:
             self._last_books_ts = now

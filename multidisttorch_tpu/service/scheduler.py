@@ -16,6 +16,21 @@ is property-testable in microseconds (tests/test_service.py):
   weight-normalized served cost is smallest, which converges to the
   weight ratio under contention and can never starve a nonempty
   tenant (its attained service freezes while others' grow).
+- **EDF inside a tenant's share**: a submission's ``deadline`` tag
+  becomes an absolute ``deadline_ts``, and within one (tenant, lane)
+  queue entries are kept in earliest-deadline-first order (deadline-
+  less best-effort work keeps FIFO order BEHIND every deadline-tagged
+  entry). Deadlines never buy cross-tenant capacity — fair share
+  decides WHICH tenant places next, EDF decides which of that
+  tenant's asks goes first — so a deadline whale cannot starve its
+  neighbors, only reorder its own backlog.
+- **Deadline preemption with an anti-thrash budget**
+  (:class:`PreemptionPolicy`): the runtime may checkpoint-drain a
+  best-effort placement to open a block for a deadline-tagged trial
+  that cannot otherwise place in time; the policy's per-trial
+  preemption cap and cooldown bound how often any single victim can
+  be bounced, so a stream of deadline whales degrades best-effort
+  throughput smoothly instead of livelocking it.
 - **Shape-bucket bin-packing**: selected trials sharing a shape bucket
   (PR 1's ``stack_bucket_key``) and submesh size co-pack into ONE
   placement — one vmapped dispatch on one submesh, tenants mixed
@@ -41,6 +56,79 @@ ADMIT = "admitted"
 REJECT_QUOTA = "rejected_quota"
 REJECT_BACKPRESSURE = "rejected_backpressure"
 REJECT_INVALID = "rejected_invalid"
+
+
+@dataclass
+class PreemptionPolicy:
+    """The anti-thrash budget for deadline-driven preemption.
+
+    Preemption is a tax best-effort work pays a deadline-tagged trial;
+    without a budget a stream of deadline whales livelocks best-effort
+    traffic (evict → restore → evict, zero useful steps between). Two
+    bounds, both property-tested:
+
+    - ``max_preemptions_per_trial``: a trial bounced this many times
+      becomes immune — its NEXT placement runs to an epoch boundary no
+      matter who is waiting.
+    - ``trial_cooldown_s``: a just-evicted trial cannot be evicted
+      again within the cooldown of its RE-PLACEMENT (the runtime calls
+      :meth:`note_replaced` when a previously-evicted trial lands on a
+      submesh again, restarting the clock), so every eviction buys the
+      victim at least a cooldown of actual running time — queue wait
+      never eats the guarantee, and with checkpoint-drain semantics
+      that running time is real banked work.
+
+    ``global_cooldown_s`` spaces preemption EVENTS (like defrag's
+    cooldown) so the planner cannot churn the pool every tick. The
+    class is pure host-side state — the loadgen drives it with virtual
+    time, the runtime with the wall clock; both share one rulebook."""
+
+    enabled: bool = True
+    max_preemptions_per_trial: int = 2
+    trial_cooldown_s: float = 2.0
+    global_cooldown_s: float = 0.25
+    # Only a deadline within this window may trigger eviction: a
+    # deadline trial with hours of slack should WAIT its EDF turn, not
+    # tax best-effort work it could have avoided taxing. inf = any
+    # blocked deadline preempts immediately (the acceptance drill's
+    # setting; production tunes it to the workload's runtimes).
+    urgency_s: float = float("inf")
+
+    # trial_id -> wall/virtual ts of its last eviction.
+    last_evict: dict = field(default_factory=dict)
+    last_event_ts: float = field(default=float("-inf"))
+
+    def event_allowed(self, now: float) -> bool:
+        return (
+            self.enabled
+            and now - self.last_event_ts >= self.global_cooldown_s
+        )
+
+    def victim_allowed(
+        self, trial_id: int, preempt_count: int, now: float
+    ) -> bool:
+        """May this trial be evicted (again) right now?"""
+        if not self.enabled:
+            return False
+        if preempt_count >= self.max_preemptions_per_trial:
+            return False
+        last = self.last_evict.get(trial_id)
+        return last is None or now - last >= self.trial_cooldown_s
+
+    def note_eviction(self, trial_id: int, now: float) -> None:
+        self.last_evict[trial_id] = now
+        self.last_event_ts = now
+
+    def note_replaced(self, trial_id: int, now: float) -> None:
+        """A previously-evicted trial just landed on a submesh again:
+        restart its cooldown from HERE, so the guarantee is a cooldown
+        of running time, not of (possibly long) queue wait."""
+        if trial_id in self.last_evict:
+            self.last_evict[trial_id] = now
+
+    def forget(self, trial_id: int) -> None:
+        """Drop a settled trial's bookkeeping (bounded-RSS contract)."""
+        self.last_evict.pop(trial_id, None)
 
 
 @dataclass(frozen=True)
@@ -109,6 +197,20 @@ class PendingTrial:
     # None for the classic single-block trial. Placed all-or-nothing;
     # never co-packed.
     sizes: Optional[tuple] = None
+    # Absolute wall (or virtual) deadline. None = best-effort: such an
+    # entry queues FIFO behind every deadline-tagged entry of its
+    # (tenant, lane) and is the only class deadline preemption may
+    # evict. The scheduler never kills an overdue trial — a missed
+    # deadline is accounted (deadline_miss), not enforced.
+    deadline_ts: Optional[float] = None
+    # Times this trial has been preemption-evicted (anti-thrash
+    # evidence — rides the entry across requeues).
+    preempt_count: int = 0
+    # Pushed with front=True (defrag victim / recovered trial): later
+    # EDF insertions must never jump ahead of it — its head-of-queue
+    # position IS the contract (a pinned victim beaten to its
+    # relocation target would waste the whole defrag window).
+    front_barrier: bool = False
 
 
 @dataclass
@@ -324,10 +426,21 @@ class FairShareScheduler:
             )
         return ADMIT, ""
 
-    def push(self, entry: PendingTrial, *, front: bool = False) -> None:
-        """Queue an admitted trial (``front=True`` requeues a
-        recovered/migrated trial ahead of its tenant's backlog — it
-        already waited once)."""
+    def push(
+        self,
+        entry: PendingTrial,
+        *,
+        front: bool = False,
+        now: Optional[float] = None,
+    ) -> None:
+        """Queue an admitted trial in EDF position: deadline-tagged
+        entries sit in ascending ``deadline_ts`` order ahead of the
+        deadline-less FIFO tail, so one (tenant, lane) queue can never
+        hold two same-tenant deadlines inverted (the EDF property
+        test). ``front=True`` requeues a recovered/migrated trial ahead
+        of EVERYTHING — it already waited (and, for a defrag victim,
+        already paid). ``now`` substitutes the wall clock for the
+        loadgen's virtual time."""
         if self.pending_count(entry.tenant) == 0:
             # Activating from idle: start at the current virtual time.
             # Idle time must not bank credit a tenant later spends as a
@@ -338,13 +451,38 @@ class FairShareScheduler:
             )
         lanes = self._pending.setdefault(entry.tenant, {})
         q = lanes.setdefault(int(entry.priority), [])
-        entry.enqueue_ts = time.time()
+        entry.enqueue_ts = time.time() if now is None else now
+        entry.front_barrier = bool(front)
         if front:
             q.insert(0, entry)
         else:
-            q.append(entry)
+            q.insert(self._edf_index(q, entry), entry)
         if entry.tenant not in self._rotation:
             self._rotation.append(entry.tenant)
+
+    @staticmethod
+    def _edf_index(q: list, entry: PendingTrial) -> int:
+        """Insertion point keeping the queue EDF-sorted: ascending
+        ``deadline_ts`` with ties FIFO, best-effort (None = +inf) kept
+        FIFO at the tail — and never ahead of a ``front_barrier``
+        entry (the front=True contract). O(n) scan from the back —
+        queues are quota-bounded and best-effort appends hit the fast
+        path."""
+        d = (
+            float("inf")
+            if entry.deadline_ts is None
+            else float(entry.deadline_ts)
+        )
+        i = len(q)
+        while i > 0:
+            prev = q[i - 1]
+            if prev.front_barrier:
+                break  # front-pushed entries keep their head position
+            other = prev.deadline_ts
+            if (float("inf") if other is None else float(other)) <= d:
+                break
+            i -= 1
+        return i
 
     def pending_entries(self) -> list[PendingTrial]:
         out = []
@@ -377,6 +515,7 @@ class FairShareScheduler:
         max_lanes: int = 4,
         now: Optional[float] = None,
         can_start: Optional[Callable[[PendingTrial], bool]] = None,
+        scan_limit: Optional[int] = None,
     ) -> list[Placement]:
         """One scheduling pass. Allocates slice blocks from ``pool``
         and dequeues the selected trials; whatever could not be placed
@@ -385,7 +524,11 @@ class FairShareScheduler:
 
         ``can_start`` lets the runtime veto an otherwise-placeable
         entry (e.g. its executable is still precompiling) without
-        consuming its fair-share turn.
+        consuming its fair-share turn. ``scan_limit`` bounds how far
+        past a blocked queue head each (tenant, lane) scan looks for
+        smaller placeable work (None = unbounded, the daemon's
+        semantics; the discrete-event loadgen passes a small window so
+        a million-submission replay stays O(1) per blocked tenant).
         """
         now = time.time() if now is None else now
         placements: list[Placement] = []
@@ -406,6 +549,11 @@ class FairShareScheduler:
             # served tenant's v just advanced.
             while True:
                 served = False
+                # Largest free run, computed ONCE per opportunity: an
+                # entry bigger than it cannot allocate anywhere, so the
+                # scan skips it in O(1) instead of walking the free map
+                # per blocked entry (the loadgen's hot path).
+                largest = pool.largest_free_run()
                 for tenant in sorted(
                     self._tenants_with_work(pri),
                     key=lambda t: (self._vsrv.get(t, 0.0), t),
@@ -415,6 +563,8 @@ class FairShareScheduler:
                         max_lanes=max_lanes, now=now,
                         contended=multi_tenant_backlog,
                         can_start=can_start,
+                        largest_free=largest,
+                        scan_limit=scan_limit,
                     ):
                         served = True
                         break
@@ -434,13 +584,18 @@ class FairShareScheduler:
         now: float,
         contended: bool,
         can_start: Optional[Callable[[PendingTrial], bool]],
+        largest_free: Optional[int] = None,
+        scan_limit: Optional[int] = None,
     ) -> bool:
-        """Try to place ONE trial of ``tenant`` in lane ``pri``
-        (FIFO within the lane). Scans past entries blocked on slice
-        shape (stamping ``blocked_since`` — defrag's starvation clock)
-        so one large trial cannot convoy its tenant's small ones."""
+        """Try to place ONE trial of ``tenant`` in lane ``pri`` (EDF
+        then FIFO within the lane — the queue is kept in that order by
+        :meth:`push`). Scans past entries blocked on slice shape
+        (stamping ``blocked_since`` — defrag's starvation clock) so one
+        large trial cannot convoy its tenant's small ones."""
         q = self._pending.get(tenant, {}).get(pri, [])
         for idx, entry in enumerate(q):
+            if scan_limit is not None and idx >= scan_limit:
+                return False
             # A pinned entry is a defrag victim being re-homed: it
             # already paid its cost when first placed, so its
             # re-placement advances no virtual time and is never
@@ -453,6 +608,15 @@ class FairShareScheduler:
                 # Vector (pipelined) request: all-or-nothing multi-
                 # block allocation, never co-packed, never pinned
                 # (pipelined placements are defrag-immovable).
+                if (
+                    largest_free is not None
+                    and max(entry.sizes) > largest_free
+                ):
+                    # No run fits even the biggest stage: blocked
+                    # without touching the free map.
+                    if entry.blocked_since is None:
+                        entry.blocked_since = now
+                    continue
                 starts = pool.alloc_multi(entry.sizes)
                 if starts is None:
                     if entry.blocked_since is None:
@@ -483,6 +647,16 @@ class FairShareScheduler:
             if attach:
                 placement = open_p
             else:
+                if (
+                    largest_free is not None
+                    and entry.size > largest_free
+                ):
+                    # Cannot allocate anywhere (an exact pinned block,
+                    # were it free, would sit inside a run >= size) and
+                    # cannot attach: blocked in O(1).
+                    if entry.blocked_since is None:
+                        entry.blocked_since = now
+                    continue
                 start = None
                 if entry.pinned_start is not None:
                     if pool.alloc_at(entry.pinned_start, entry.size):
@@ -537,6 +711,24 @@ class FairShareScheduler:
             self.contended_cost[tenant] = (
                 self.contended_cost.get(tenant, 0.0) + entry.cost
             )
+
+    # -- deadlines ----------------------------------------------------
+
+    def deadline_pending(
+        self, *, now: Optional[float] = None
+    ) -> list[PendingTrial]:
+        """Deadline-tagged pending entries, earliest deadline first —
+        the preemption trigger's candidate list (the runtime preempts
+        for at most one per pass). Entries whose deadline already
+        passed still sort first: they place soonest and the miss is
+        accounted at settle time, never enforced by killing."""
+        out = [
+            e
+            for e in self.pending_entries()
+            if e.deadline_ts is not None
+        ]
+        out.sort(key=lambda e: (e.deadline_ts, e.enqueue_ts))
+        return out
 
     # -- starvation ---------------------------------------------------
 

@@ -81,6 +81,24 @@ def main() -> int:
     )
     parser.add_argument("--mh-victim", type=int, default=1)
     parser.add_argument(
+        "--fabric", action="store_true",
+        help="run the service-fabric failover drill instead: 2 fabric "
+        "replica daemons armed with a seeded FaultPlan whose "
+        "daemon_lost spec SIGKILLs the victim replica on its dispatch "
+        "clock; the survivor must adopt the orphaned shard (lease-"
+        "fenced epoch claim + journal replay) and settle every "
+        "submission (docs/SERVICE.md \"Service fabric\")",
+    )
+    parser.add_argument(
+        "--fabric-victim", type=int, default=1, choices=(0, 1),
+        help="which of the two replicas the daemon_lost spec targets",
+    )
+    parser.add_argument(
+        "--fabric-step", type=int, default=12,
+        help="the victim's cumulative dispatch count at which "
+        "daemon_lost fires",
+    )
+    parser.add_argument(
         "--mh-groups", default="per_host",
         help="submesh carve for the drill: 'per_host' (default; "
         "bit-parity applies, and the wedge surfaces at the bounded "
@@ -115,6 +133,41 @@ def main() -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     work_dir = args.work_dir or tempfile.mkdtemp(prefix="chaos_run_")
+
+    if args.fabric:
+        from multidisttorch_tpu.service.fabric_drill import (
+            run_fabric_chaos,
+        )
+
+        report = run_fabric_chaos(
+            work_dir,
+            victim=args.fabric_victim,
+            step=args.fabric_step,
+            seed=args.seed,
+        )
+        headline = {
+            "metric": "fabric_chaos_zero_lost_after_daemon_lost",
+            "value": 1.0 if report["zero_lost"] else 0.0,
+            "unit": "all submissions settled across a SIGKILLed "
+            "replica + shard adoption",
+            "victim_sigkilled": report["victim_sigkilled"],
+            "fault_fired": report["fault_fired"],
+            "survivor_claimed_victims_shard": report[
+                "survivor_claimed_victims_shard"
+            ],
+            "completed": report["completed"],
+            "submissions": report["submissions"],
+            "detail": report,
+        }
+        print(json.dumps(headline))
+        if args.out:
+            os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+            tmp = args.out + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(headline, f, indent=2)
+            os.replace(tmp, args.out)
+            print(f"report written to {args.out}", file=sys.stderr)
+        return 0 if report["ok"] else 1
 
     if args.multihost:
         from multidisttorch_tpu.faults.harness import run_chaos_mh_bench

@@ -21,6 +21,23 @@ kills immediately. Under ``tools/sweep_supervisor.py`` (launch with
 resumes from its journal — the service's elastic-restart story. With
 ``MDT_HOST_SLOT`` set (the supervisor sets it) the daemon heartbeats a
 membership lease so a wedged daemon is detected without collectives.
+
+**Fabric mode** (docs/SERVICE.md "Service fabric"): ``--fabric`` runs
+one :class:`~multidisttorch_tpu.service.fabric.FabricReplica` instead
+of a bare single-controller daemon —
+
+    python tools/sweep_service.py <service-dir> --fabric \\
+        --replica 0 --n-shards 2 --slices 2
+
+The replica claims orphaned tenant shards through epoch-fenced leases,
+runs one fenced ``SweepService`` per owned shard, and adopts a dead
+peer's shard (journal replay + checkpoint re-homing) when its lease
+goes stale. ``--replica`` defaults to ``MDT_HOST_SLOT``, so N replicas
+under the elastic supervisor (``sweep_supervisor.py --hosts N --
+python tools/sweep_service.py <dir> --fabric --n-shards N …``) each
+take a host slot. ``--fault-plan`` arms the seeded chaos machinery
+(``daemon_lost`` SIGKILLs this replica on its dispatch clock — the
+drillable failover of ``tools/chaos_run.py --fabric``).
 """
 
 from __future__ import annotations
@@ -76,6 +93,23 @@ def main(argv=None) -> int:
     parser.add_argument("--precompile", action="store_true",
                         help="warm admitted trials' executables on the "
                         "AOT farm before placement (docs/COMPILE.md)")
+    parser.add_argument("--fabric", action="store_true",
+                        help="run as a service-fabric replica (shard "
+                        "leases, fenced ownership, orphan adoption — "
+                        "docs/SERVICE.md)")
+    parser.add_argument("--replica", type=int, default=None,
+                        help="this replica's stable id (default: "
+                        "MDT_HOST_SLOT, else 0)")
+    parser.add_argument("--n-shards", type=int, default=2,
+                        help="fabric shard count (every replica and "
+                        "client must agree; first writer pins it)")
+    parser.add_argument("--lease-deadline", type=float, default=3.0,
+                        help="seconds without a lease renewal before a "
+                        "shard counts orphaned and is adopted")
+    parser.add_argument("--fault-plan", default=None,
+                        help="arm a FaultPlan JSON against this "
+                        "replica's dispatch clock (daemon_lost etc.; "
+                        "fired log under {service_dir}/fabric/)")
     parser.add_argument("--exit-when-drained", action="store_true",
                         help="exit once queue+spool+submeshes are idle "
                         "(CI/bench mode; default: keep serving)")
@@ -92,6 +126,11 @@ def main(argv=None) -> int:
     if not telemetry.enabled():
         telemetry.configure(os.path.join(args.service_dir, "telemetry"))
     slot = os.environ.get("MDT_HOST_SLOT")
+    if slot is None and args.fabric and args.replica is not None:
+        # A fabric replica always heartbeats: the console's replica
+        # health and the supervisor's staleness verdict both read the
+        # membership lease, launcher or not.
+        slot = str(args.replica)
     if slot is not None:
         membership.start_heartbeat(
             args.service_dir,
@@ -116,8 +155,7 @@ def main(argv=None) -> int:
         )
         for name in set(weights) | set(quotas)
     }
-    svc = SweepService(
-        args.service_dir,
+    svc_kwargs = dict(
         n_slices=args.slices,
         max_lanes=args.max_lanes,
         policies=policies,
@@ -129,6 +167,38 @@ def main(argv=None) -> int:
         verbose=args.verbose,
         precompile=args.precompile,
     )
+    if args.fabric:
+        from multidisttorch_tpu.service.fabric import FabricReplica
+
+        replica = (
+            args.replica
+            if args.replica is not None
+            else int(os.environ.get("MDT_HOST_SLOT", "0") or 0)
+        )
+        injector = None
+        if args.fault_plan:
+            from multidisttorch_tpu.faults.inject import FaultInjector
+            from multidisttorch_tpu.faults.plan import FaultPlan
+
+            with open(args.fault_plan) as f:
+                plan = FaultPlan.from_json(f.read())
+            injector = FaultInjector(
+                plan,
+                host_slot=replica,
+                fired_log=os.path.join(
+                    args.service_dir, "fabric", f"fired-{replica}.jsonl"
+                ),
+            )
+        svc = FabricReplica(
+            args.service_dir,
+            replica=replica,
+            n_shards=args.n_shards,
+            lease_deadline_s=args.lease_deadline,
+            injector=injector,
+            **svc_kwargs,
+        )
+    else:
+        svc = SweepService(args.service_dir, **svc_kwargs)
 
     def on_signal(signum, frame):
         if svc._stop:  # second signal: the operator means it

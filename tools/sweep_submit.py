@@ -43,7 +43,11 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--deadline", type=float, default=None,
-        help="advisory deadline in seconds (surfaced in the books)",
+        help="deadline in seconds from submission: EDF-orders the "
+        "trial inside your fair share and may checkpoint-drain "
+        "preempt best-effort lanes within the anti-thrash budget "
+        "(docs/SERVICE.md \"Deadlines\"); hits/misses land in the "
+        "books — an overdue trial is never killed",
     )
     parser.add_argument(
         "--count", type=int, default=1,

@@ -43,6 +43,23 @@ exit it folds every shard into the merged fleet artifacts
 (``telemetry/fleet/``: merged events + Perfetto fleet trace +
 ``fleet_summary.json``).
 
+**Service-fabric worlds** (docs/SERVICE.md "Service fabric"): launch N
+fabric replicas as the worker command —
+
+    python tools/sweep_supervisor.py --hosts 2 --run-dir out/svc \
+        -- python tools/sweep_service.py out/svc --fabric --n-shards 2
+
+each replica reads its ``MDT_HOST_SLOT`` as its replica id, heartbeats
+the same membership lease the supervisor watches, and claims its home
+shard through the fabric's epoch-fenced leases. The division of labor:
+the FABRIC keeps serving through a replica death (a survivor adopts
+the orphaned shard within the lease deadline — zero lost submissions,
+no supervisor involvement), while the SUPERVISOR resurrects the dead
+process into the next world so the fleet converges back to one shard
+per replica. A relaunched replica whose shard was adopted meanwhile
+simply finds no orphan to claim until the adopter drains or dies —
+the fence makes the handoff race-free.
+
 Worker environment per world (the framework's own OpenMPI-style
 detection, ``parallel/cluster.py``): ``OMPI_COMM_WORLD_SIZE/RANK``
 over the SURVIVING slots, a fresh ``MASTER_PORT`` per world (no

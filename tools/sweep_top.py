@@ -588,11 +588,68 @@ def service_state(service_dir: str):
     return ServiceFollow(service_dir).refresh()
 
 
+def fabric_panel(service_dir: str, *, deadline_s: float = 3.0) -> str:
+    """Shard + replica health over a fabric root (docs/SERVICE.md
+    "Service fabric"): per-shard owner/epoch/lease verdict from the
+    fenced lease streams, per-replica liveness from the membership
+    heartbeats."""
+    from multidisttorch_tpu.parallel import membership
+    from multidisttorch_tpu.service import fabric
+
+    health = fabric.fabric_health(
+        service_dir, lease_deadline_s=deadline_s
+    )
+    lines = [f"service fabric  {service_dir}", ""]
+    rows = []
+    for k in sorted(health["shards"]):
+        s = health["shards"][k]
+        rows.append(
+            [
+                f"shard-{k}",
+                s.get("replica", "-"),
+                s.get("epoch", "-"),
+                fmt_duration(s.get("lease_age_s"))
+                if s.get("lease_age_s") is not None
+                else "-",
+                s["state"].upper()
+                if s["state"] in ("stale", "unclaimed")
+                else s["state"],
+            ]
+        )
+    if rows:
+        lines.append(
+            fmt_table(rows, ["shard", "owner", "epoch", "lease", "state"])
+        )
+    view = membership.MembershipView(service_dir)
+    now = time.time()
+    reps = []
+    for slot, rec in sorted(view.hosts().items()):
+        age = now - float(rec.get("ts", 0.0))
+        if rec.get("status") == membership.LEFT:
+            verdict = "left"
+        elif age > deadline_s:
+            verdict = "STALE"
+        else:
+            verdict = "alive"
+        reps.append(
+            [f"replica-{slot}", rec.get("pid", "-"),
+             fmt_duration(age), verdict]
+        )
+    if reps:
+        lines.append("")
+        lines.append(
+            fmt_table(reps, ["replica", "pid", "beat", "health"])
+        )
+    lines.append("")
+    return "\n".join(lines)
+
+
 def render_service(folded, books, state, service_dir: str) -> str:
     """Tenant/queue panel over a service directory (docs/SERVICE.md):
     queue depth by state, per-tenant goodput + fair-share vs weight,
-    scheduling-latency books, the fragmentation gauge and defrag
-    accounting, then the per-trial table of whatever telemetry shows."""
+    scheduling-latency books, the fragmentation gauge, defrag +
+    preemption accounting and the deadline scoreboard, then the
+    per-trial table of whatever telemetry shows."""
     from multidisttorch_tpu.service.queue import QueueStats
 
     now = time.time()
@@ -620,6 +677,22 @@ def render_service(folded, books, state, service_dir: str) -> str:
             f"defrag  events {dfr['events']}  moved slices "
             f"{dfr.get('moved_slices')}  unblocked "
             f"{len(dfr.get('unblocked') or [])}"
+        )
+    pre = books.get("preemption") or {}
+    if pre.get("events"):
+        lines.append(
+            f"preempt  events {pre['events']}  evictions "
+            f"{pre.get('evictions')}  slices "
+            f"{pre.get('evicted_slices')}  unblocked "
+            f"{len(pre.get('unblocked') or [])}"
+        )
+    dl = books.get("deadline") or {}
+    if dl.get("hits") or dl.get("misses") or dl.get("pending"):
+        lines.append(
+            f"deadline  hits {dl.get('hits', 0)}  misses "
+            f"{dl.get('misses', 0)}  hit-rate "
+            f"{dl.get('hit_rate') if dl.get('hit_rate') is not None else '-'}"
+            f"  pending {dl.get('pending', 0)}"
         )
     for label, key in (
         ("queue-wait", "queue_wait"),
@@ -675,6 +748,18 @@ def render_service(folded, books, state, service_dir: str) -> str:
     if live:
         rows = []
         for r in sorted(live, key=lambda r: r.get("submit_ts") or 0.0):
+            # Deadline column: time remaining on the submission's tag
+            # (negative = already overdue), "-" for best-effort.
+            dl_s = r.get("deadline_s")
+            if dl_s is not None and r.get("submit_ts"):
+                remaining = r["submit_ts"] + float(dl_s) - now
+                dl_cell = (
+                    f"-{fmt_duration(-remaining)}"
+                    if remaining < 0
+                    else fmt_duration(remaining)
+                )
+            else:
+                dl_cell = "-"
             rows.append(
                 [
                     r["submission_id"][:24],
@@ -684,12 +769,14 @@ def render_service(folded, books, state, service_dir: str) -> str:
                     r.get("size", 1),
                     fmt_duration(now - r["submit_ts"])
                     if r.get("submit_ts") else "-",
+                    dl_cell,
                 ]
             )
         lines.append(
             fmt_table(
                 rows,
-                ["submission", "tenant", "pri", "state", "size", "age"],
+                ["submission", "tenant", "pri", "state", "size", "age",
+                 "deadline"],
             )
         )
         lines.append("")
@@ -724,8 +811,11 @@ def main(argv=None) -> int:
         help="tenant/queue view over a sweep SERVICE directory "
         "(docs/SERVICE.md): submission-queue depth by state, per-tenant "
         "goodput and fair-share vs weight, queue-wait/placement-latency "
-        "books, the fragmentation gauge and defrag accounting, plus the "
-        "usual per-trial table when telemetry is on",
+        "books, the fragmentation gauge, defrag/preemption accounting "
+        "and the deadline scoreboard, plus the usual per-trial table "
+        "when telemetry is on; over a FABRIC root, adds per-shard "
+        "owner/epoch/lease health and replica heartbeats and renders "
+        "every shard's panel",
     )
     parser.add_argument(
         "--deadline", type=float, default=3.0,
@@ -754,35 +844,70 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 1
 
+        from multidisttorch_tpu.service import fabric as _fabric
+
+        fabric_cfg = _fabric.read_fabric_config(args.path)
+        shard_dirs = (
+            {
+                k: _fabric.shard_dir(args.path, k)
+                for k in range(int(fabric_cfg["n_shards"]))
+            }
+            if fabric_cfg
+            else {None: args.path}
+        )
+
+        def render_all(states) -> str:
+            parts = []
+            if fabric_cfg:
+                parts.append(
+                    fabric_panel(args.path, deadline_s=args.deadline)
+                )
+            for k, (folded, books, state) in states.items():
+                d = shard_dirs[k]
+                parts.append(render_service(folded, books, state, d))
+            return "\n".join(parts)
+
         def service_shot():
-            folded, books, state = service_state(args.path)
+            states = {
+                k: service_state(d) for k, d in shard_dirs.items()
+            }
             if args.json:
-                print(json.dumps(
-                    {
-                        "service_dir": args.path,
+                snap = {
+                    "service_dir": args.path,
+                    "shards": {},
+                }
+                if fabric_cfg:
+                    snap["fabric"] = _fabric.fabric_health(
+                        args.path, lease_deadline_s=args.deadline
+                    )
+                for k, (folded, books, state) in states.items():
+                    snap["shards"][str(k) if k is not None else "_"] = {
                         "queue": folded,
                         "books": books,
                         "trials": {
-                            k: state.trials[k]
-                            for k in sorted(state.trials)
+                            t: state.trials[t]
+                            for t in sorted(state.trials)
                         },
-                    },
-                    default=str,
-                ))
+                    }
+                if not fabric_cfg:
+                    # Pre-fabric shape, kept for scripts: the single
+                    # service's fold at top level.
+                    only = snap["shards"]["_"]
+                    snap.update(only)
+                print(json.dumps(snap, default=str))
             else:
-                print(render_service(folded, books, state, args.path))
+                print(render_all(states))
 
         if not args.follow:
             service_shot()
             return 0
         refreshes = 0
-        fol = ServiceFollow(args.path)
+        fols = {k: ServiceFollow(d) for k, d in shard_dirs.items()}
         try:
             while True:
-                folded, books, state = fol.refresh()
+                states = {k: f.refresh() for k, f in fols.items()}
                 print(
-                    clear_screen()
-                    + render_service(folded, books, state, args.path),
+                    clear_screen() + render_all(states),
                     flush=True,
                 )
                 refreshes += 1
