@@ -110,6 +110,18 @@ class Entry:
 def _emit(kind: str, **data) -> None:
     bus = get_bus()
     if bus is not None:
+        # Submission-trace attribution (telemetry/trace.py): the
+        # registry is program-keyed — a compile serves every member of
+        # a co-packed placement — so the caller installs WHO is waiting
+        # on it (trial ids + trace ids) in a thread-local and the
+        # events ride it. Checked only when a bus exists: the
+        # telemetry-off path never touches the thread-local.
+        from multidisttorch_tpu.telemetry.trace import current_attribution
+
+        attr = current_attribution()
+        if attr is not None:
+            data.setdefault("trial_ids", attr["trial_ids"])
+            data.setdefault("traces", attr["traces"])
         bus.emit(kind, **data)
 
 

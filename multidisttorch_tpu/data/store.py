@@ -699,7 +699,33 @@ class DatasetStore:
         return UNKNOWN
 
     def _prefetch_job(self, spec: str) -> None:
-        self.get(spec)  # lands in the RAM LRU + disk; result dropped
+        # dataset_prefetch_end closes the trace span the runtime's
+        # dataset_prefetch_queued instant opened (telemetry/trace.py);
+        # emitted from the worker thread — the bus is thread-safe, and
+        # with telemetry off no object is ever constructed.
+        from multidisttorch_tpu.telemetry.events import get_bus
+
+        t0 = time.perf_counter()
+        try:
+            self.get(spec)  # lands in the RAM LRU + disk; result dropped
+        except BaseException:
+            bus = get_bus()
+            if bus is not None:
+                bus.emit(
+                    "dataset_prefetch_end",
+                    spec=spec,
+                    ok=False,
+                    wall_s=round(time.perf_counter() - t0, 4),
+                )
+            raise
+        bus = get_bus()
+        if bus is not None:
+            bus.emit(
+                "dataset_prefetch_end",
+                spec=spec,
+                ok=True,
+                wall_s=round(time.perf_counter() - t0, 4),
+            )
 
     def prefetch_error(self, spec: str) -> Optional[BaseException]:
         with self._lock:

@@ -120,12 +120,14 @@ class SweepLedger:
     @staticmethod
     def _tag_fields(
         tenant: Optional[str], priority: Optional[int],
-        submit_ts: Optional[float],
+        submit_ts: Optional[float], trace: Optional[str] = None,
     ) -> dict:
         """Optional multi-tenant provenance (the sweep service's
-        scheduling books key off these). Absent tags serialize NOTHING
-        — pre-service ledgers and single-tenant sweeps stay
-        byte-identical, and old records parse unchanged."""
+        scheduling books key off these; ``trace`` is the submission's
+        end-to-end trace id — docs/OBSERVABILITY.md "Tracing & SLOs").
+        Absent tags serialize NOTHING — pre-service ledgers and
+        single-tenant sweeps stay byte-identical, and old records
+        parse unchanged."""
         out: dict = {}
         if tenant is not None:
             out["tenant"] = str(tenant)
@@ -133,6 +135,8 @@ class SweepLedger:
             out["priority"] = int(priority)
         if submit_ts is not None:
             out["submit_ts"] = float(submit_ts)
+        if trace is not None:
+            out["trace"] = str(trace)
         return out
 
     def attempt_start(
@@ -141,6 +145,7 @@ class SweepLedger:
         tenant: Optional[str] = None,
         priority: Optional[int] = None,
         submit_ts: Optional[float] = None,
+        trace: Optional[str] = None,
     ) -> None:
         # Telemetry rides the ledger's call sites: every attempt
         # boundary in the driver (classic AND stacked-lane paths)
@@ -149,7 +154,7 @@ class SweepLedger:
         # observes attempts even when the ledger file itself is off.
         from multidisttorch_tpu.telemetry.events import get_bus
 
-        tags = self._tag_fields(tenant, priority, submit_ts)
+        tags = self._tag_fields(tenant, priority, submit_ts, trace)
         bus = get_bus()
         if bus is not None:
             bus.emit(
@@ -181,6 +186,7 @@ class SweepLedger:
         tenant: Optional[str] = None,
         priority: Optional[int] = None,
         submit_ts: Optional[float] = None,
+        trace: Optional[str] = None,
     ) -> None:
         """``status``: completed | diverged | retrying | failed |
         preempted. ``summary`` (completed/diverged) carries enough to
@@ -189,7 +195,7 @@ class SweepLedger:
         from multidisttorch_tpu.telemetry.events import get_bus
         from multidisttorch_tpu.telemetry.metrics import get_registry
 
-        tags = self._tag_fields(tenant, priority, submit_ts)
+        tags = self._tag_fields(tenant, priority, submit_ts, trace)
         bus = get_bus()
         if bus is not None:
             bus.emit(

@@ -361,7 +361,10 @@ class FabricClient:
 
     def submit(self, config: dict, *, tenant: Optional[str] = None, **kw):
         ten = self.tenant if tenant is None else tenant
-        return self._shard_client(ten).submit(config, tenant=ten, **kw)
+        c = self._shard_client(ten)
+        sid = c.submit(config, tenant=ten, **kw)
+        self.last_submission = c.last_submission  # the full receipt
+        return sid
 
     def _folds(self) -> dict[str, dict]:
         out: dict[str, dict] = {}
@@ -520,7 +523,15 @@ class FabricReplica:
         d = shard_dir(self.service_dir, shard)
         os.makedirs(d, exist_ok=True)
         t0 = time.perf_counter()
-        svc = SweepService(d, fence=fence.check, **self.svc_kwargs)
+        # fence_epoch stamps every journal/ledger record this
+        # incarnation writes — the submission traces' evidence that a
+        # failover's span tree is contiguous across the takeover.
+        svc = SweepService(
+            d,
+            fence=fence.check,
+            fence_epoch=fence.epoch,
+            **self.svc_kwargs,
+        )
         try:
             # Construction (journal replay, dataset build) consumed
             # lease time: refresh it before the first tick, or drop

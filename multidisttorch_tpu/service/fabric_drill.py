@@ -278,6 +278,28 @@ def run_failover_phase(work_dir: str) -> dict:
         )
     }
 
+    # Trace-completeness drill (docs/OBSERVABILITY.md "Tracing &
+    # SLOs"): every settled submission must reconstruct — offline,
+    # from the durable shard journals/ledgers alone — as ONE
+    # contiguous span tree with zero orphans, and the SIGKILLed
+    # shard's re-homed submissions must span BOTH fence epochs.
+    from multidisttorch_tpu.telemetry import trace as ttrace
+
+    trace_export = ttrace.export_traces(
+        service_dir, os.path.join(work_dir, "fabric_traces")
+    )
+    completeness = trace_export["completeness"]
+    trace_block = {
+        "completeness": completeness,
+        "exported": {
+            k: trace_export[k] for k in ("spans", "perfetto")
+        },
+        "rehomed_cross_epoch": bool(
+            completeness["epoch_takeovers"] >= 1
+            and completeness["multi_epoch_submissions"] >= 1
+        ),
+    }
+
     return {
         "submissions": len(all_ids),
         "kill_exercised": kill_exercised,
@@ -300,6 +322,7 @@ def run_failover_phase(work_dir: str) -> dict:
             "bit_identical": compared > 0 and not mismatched,
         },
         "shard_events": shard_events,
+        "trace": trace_block,
         "fabric_health": fabric.fabric_health(service_dir),
         "logs": [log0, log1],
     }
@@ -612,6 +635,12 @@ def run_loadgen_phase(n_submissions: int, *, seed: int = 0) -> dict:
         "p99_recorded": bool(
             report["placement_latency_s"].get("count")
         ),
+        # Offline SLO verdict, exact off the banked full histogram —
+        # the scalar-percentile gates above stay as cross-checks.
+        "slo_met": report["slo"]["met"],
+        "slo_exact": all(
+            s.get("exact") for s in report["slo"]["slos"].values()
+        ),
     }
     report["ok"] = all(report["gates"].values())
     return report
@@ -635,6 +664,11 @@ def run_fabric_bench(
         "shard_adopted_by_survivor": failover["adoption_evident"],
         "rehomed_trials_present": failover["rehomed_count"] >= 1,
         "rehomed_bit_identical": failover["parity"]["bit_identical"],
+        # Trace completeness (ISSUE 14): every settled submission of
+        # the SIGKILL drill reconstructs as one contiguous span tree
+        # with zero orphan spans, spanning both fence epochs.
+        "trace_complete": failover["trace"]["completeness"]["complete"],
+        "trace_cross_epoch": failover["trace"]["rehomed_cross_epoch"],
         "deadline_preemption_drill": deadline["ok"],
         "loadgen_gates": loadgen["ok"],
     }

@@ -40,6 +40,23 @@ QUEUE_NAME = "queue.jsonl"
 INTAKE_DIR = "intake"
 
 
+def mint_trace_id() -> str:
+    """A fresh end-to-end trace id (docs/OBSERVABILITY.md "Tracing &
+    SLOs"). The ONE minting convention — ``SweepClient.submit`` calls
+    it, ``telemetry/trace.py`` re-exports it."""
+    return uuid.uuid4().hex[:16]
+
+
+def default_trace_id(submission_id: str) -> str:
+    """Deterministic trace id for records minted before tracing
+    existed (re-exported by ``telemetry/trace.py`` — defined here so
+    the queue layer derives it without importing telemetry)."""
+    import hashlib
+
+    h = hashlib.sha256(f"sub:{submission_id}".encode()).hexdigest()
+    return "d" + h[:15]
+
+
 def fsync_dir(path: str) -> None:
     """Flush a directory's entry table (``train/checkpoint.py``'s
     atomic-write discipline, duplicated here so the queue stays
@@ -93,6 +110,17 @@ class Submission:
     size: int = 1
     deadline_s: Optional[float] = None
     submit_ts: float = 0.0
+    # End-to-end trace id (docs/OBSERVABILITY.md "Tracing & SLOs"):
+    # minted client-side at submit, rides the spool record and every
+    # journal/ledger/telemetry record after it. Empty = an old client;
+    # readers derive a deterministic fallback (``trace`` property).
+    trace_id: str = ""
+
+    @property
+    def trace(self) -> str:
+        """The submission's trace id: explicit when minted, else the
+        deterministic derivation every reader agrees on."""
+        return self.trace_id or default_trace_id(self.submission_id)
 
     def to_dict(self) -> dict:
         d = {
@@ -105,6 +133,9 @@ class Submission:
         }
         if self.deadline_s is not None:
             d["deadline_s"] = float(self.deadline_s)
+        if self.trace_id:
+            # Absent when unset: pre-trace records stay byte-identical.
+            d["trace_id"] = self.trace_id
         return d
 
     @classmethod
@@ -121,6 +152,7 @@ class Submission:
                 else None
             ),
             submit_ts=float(d.get("submit_ts", 0.0)),
+            trace_id=str(d.get("trace_id", "") or ""),
         )
 
 
@@ -170,6 +202,10 @@ class SweepClient:
             size=size,
             deadline_s=deadline_s,
             submit_ts=time.time(),
+            # The trace id is minted HERE, at the very front door, so
+            # the spool-wait phase (client commit -> daemon drain) is
+            # inside the trace — a daemon-side mint could never see it.
+            trace_id=mint_trace_id(),
         )
         d = intake_dir(self.service_dir)
         os.makedirs(d, exist_ok=True)
@@ -185,6 +221,9 @@ class SweepClient:
         # the page cache). The call sequence — file fsync, rename, dir
         # fsync — is regression-tested (tests/test_fabric.py).
         fsync_dir(d)
+        # The full receipt (submission + trace id) for callers that
+        # want more than the id — tools/sweep_submit.py prints both.
+        self.last_submission = sub
         return sub.submission_id
 
     def status(self, submission_id: str) -> Optional[dict]:
@@ -246,7 +285,12 @@ class SubmissionQueue:
     recover exactly where the previous incarnation died."""
 
     def __init__(
-        self, service_dir: str, *, write: bool = True, fence=None
+        self,
+        service_dir: str,
+        *,
+        write: bool = True,
+        fence=None,
+        epoch: Optional[int] = None,
     ):
         self.service_dir = service_dir
         self.path = queue_path(service_dir)
@@ -256,6 +300,15 @@ class SubmissionQueue:
         # transitions must be REJECTED, never interleaved with the new
         # owner's journal.
         self._fence = fence
+        # Fencing epoch of the writer (fabric replicas): stamped on
+        # every record so an offline reader can see WHICH incarnation
+        # wrote each transition — the trace layer's evidence that a
+        # submission's spans are contiguous across a takeover. None
+        # (plain single-controller service) serializes nothing.
+        self.epoch = epoch
+        # submission_id -> trace id, fed by drain_intake and the
+        # recovery fold: every transition record rides the trace.
+        self.trace_ids: dict[str, str] = {}
         self._tail_checked = False
 
     # -- journal ------------------------------------------------------
@@ -290,6 +343,14 @@ class SubmissionQueue:
             self._fence()
         os.makedirs(self.service_dir, exist_ok=True)
         self._terminate_torn_tail()
+        sid = record.get("submission_id") or (
+            record.get("sub") or {}
+        ).get("submission_id")
+        trace = self.trace_ids.get(sid) if sid else None
+        if trace:
+            record = {**record, "trace": trace}
+        if self.epoch is not None:
+            record = {**record, "epoch": int(self.epoch)}
         line = json.dumps({**record, "ts": time.time()}, default=str)
         created = not os.path.exists(self.path)
         with open(self.path, "a") as f:
@@ -331,6 +392,7 @@ class SubmissionQueue:
             except (OSError, json.JSONDecodeError, KeyError, ValueError):
                 continue  # torn/garbled spool file: never committed
             if sub.submission_id not in known_ids:
+                self.trace_ids[sub.submission_id] = sub.trace
                 self.append({"event": "submitted", "sub": sub.to_dict()})
                 known_ids.add(sub.submission_id)
                 fresh.append(sub)
@@ -503,6 +565,7 @@ def fold_queue_into(
             out[sid] = {
                 "submission_id": sid,
                 "state": PENDING,
+                "trace_id": sub.get("trace_id") or default_trace_id(sid),
                 "tenant": sub.get("tenant", "default"),
                 "priority": int(sub.get("priority", 1)),
                 "size": int(sub.get("size", 1)),

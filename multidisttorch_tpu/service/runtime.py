@@ -75,6 +75,7 @@ from multidisttorch_tpu.service.scheduler import (
     SlicePool,
     TenantPolicy,
 )
+from multidisttorch_tpu.telemetry import trace as ttrace
 from multidisttorch_tpu.utils.logging import log0
 
 BOOKS_NAME = "service_books.json"
@@ -108,21 +109,30 @@ class TaggedLedger(SweepLedger):
     open, so a stale incarnation's appends are REJECTED, never
     interleaved (docs/SERVICE.md "Fencing")."""
 
-    def __init__(self, out_dir: str, *, fence=None, **kw):
+    def __init__(self, out_dir: str, *, fence=None, epoch=None, **kw):
         super().__init__(out_dir, **kw)
         self.tags: dict[int, dict] = {}
         self._fence = fence
+        # Fencing epoch of the writing replica (fabric): stamped on
+        # every record, like the journal's — the trace layer's
+        # takeover evidence. None serializes nothing (byte-compat).
+        self._epoch = epoch
 
     def append(self, event: dict) -> None:
         if self._fence is not None:
             self._fence()
+        if self._epoch is not None:
+            event = {**event, "epoch": int(self._epoch)}
         super().append(event)
 
-    def tag(self, trial_id: int, *, tenant, priority, submit_ts) -> None:
+    def tag(
+        self, trial_id: int, *, tenant, priority, submit_ts, trace=None
+    ) -> None:
         self.tags[trial_id] = {
             "tenant": tenant,
             "priority": priority,
             "submit_ts": submit_ts,
+            **({"trace": trace} if trace else {}),
         }
 
     def attempt_start(self, trial_id, chash, attempt, **kw):
@@ -224,6 +234,12 @@ class _Active:
     # stage. None for classic placements; when set, start/size hold
     # the first block / the total and freeing walks every block.
     blocks: Optional[list] = None
+    # Prebuilt trace attribution (telemetry/trace.py): the member
+    # (trial_id, trace_id) pairs, installed around each cooperative
+    # dispatch so compile-registry events ride the members' traces.
+    # Built ONCE at placement; the per-dispatch cost is two
+    # thread-local writes, and zero when telemetry is off.
+    trace_attr: Optional[dict] = None
 
     def free_blocks(self) -> list:
         return list(self.blocks) if self.blocks else [(self.start, self.size)]
@@ -283,6 +299,8 @@ class SweepService:
         defrag_cooldown_s: float = 1.0,
         preempt: Optional[PreemptionPolicy] = None,
         fence=None,
+        fence_epoch: Optional[int] = None,
+        slos=None,
         retry: Optional[RetryPolicy] = None,
         save_checkpoints: bool = True,
         ckpt_keep_last: int = 2,
@@ -319,8 +337,23 @@ class SweepService:
         # so a paused-and-resumed replica cannot double-place work the
         # new owner already re-homed.
         self._fence = fence
-        self.queue = squeue.SubmissionQueue(service_dir, fence=fence)
-        self.ledger = TaggedLedger(service_dir, fence=fence)
+        # The fencing epoch (fabric replicas) is stamped on every
+        # journal/ledger record this incarnation writes — the offline
+        # trace builder's evidence that a submission's span tree is
+        # contiguous across a lease takeover.
+        self.fence_epoch = fence_epoch
+        self.queue = squeue.SubmissionQueue(
+            service_dir, fence=fence, epoch=fence_epoch
+        )
+        self.ledger = TaggedLedger(
+            service_dir, fence=fence, epoch=fence_epoch
+        )
+        # Live SLO engine (telemetry/slo.py): observations ride the
+        # existing latency/deadline/goodput seams, evaluation lands in
+        # the books at the books cadence plus typed slo_* events.
+        from multidisttorch_tpu.telemetry.slo import SloEngine
+
+        self.slo = SloEngine(slos)
         self.train_data = (
             train_data
             if train_data is not None
@@ -441,6 +474,13 @@ class SweepService:
         checkpoint instead of retraining from scratch)."""
         folded = squeue.fold_queue(self.queue.load())
         self._known_ids = set(folded)
+        for sid, rec in folded.items():
+            # Recovered submissions keep their minted trace ids: the
+            # adopter's journal records join the same trace as the
+            # dead incarnation's (the failover-contiguity contract).
+            self.queue.trace_ids[sid] = rec.get(
+                "trace_id"
+            ) or squeue.default_trace_id(sid)
         prior_attempts = self.ledger.attempts()
         # Trial-id high-water mark FIRST, before any re-admission: a
         # submission the previous incarnation journaled but died before
@@ -468,6 +508,7 @@ class SweepService:
                     "size": rec["size"],
                     "deadline_s": rec.get("deadline_s"),
                     "submit_ts": rec["submit_ts"],
+                    "trace_id": rec.get("trace_id", ""),
                 }
             )
             if rec["state"] == squeue.PENDING:
@@ -513,6 +554,7 @@ class SweepService:
                 tenant=sub.tenant,
                 priority=sub.priority,
                 submit_ts=sub.submit_ts,
+                trace=sub.trace,
             )
             self.entries[entry.trial_id] = entry
             if rec["state"] == squeue.PLACED:
@@ -649,6 +691,7 @@ class SweepService:
             data_sig=dsig,
             resume_scan=resume_scan,
             sizes=sizes,
+            trace_id=sub.trace,
             # The deadline tag becomes an absolute EDF key: submit
             # time + the tenant's relative budget. Recovery rebuilds
             # the SAME deadline_ts from the journaled submission, so a
@@ -692,6 +735,7 @@ class SweepService:
                 tenant=sub.tenant,
                 verdict=verdict,
                 reason=reason,
+                trace=sub.trace,
             )
             return
         self.next_trial_id = tid + 1
@@ -703,6 +747,7 @@ class SweepService:
             tenant=sub.tenant,
             priority=sub.priority,
             submit_ts=sub.submit_ts,
+            trace=sub.trace,
         )
         self.entries[tid] = entry
         self.queue.admitted(
@@ -720,6 +765,7 @@ class SweepService:
             priority=sub.priority,
             size=sub.size,
             bucket=str(entry.bucket),
+            trace=sub.trace,
         )
         self._prefetch_data(entry)
         self._warm(entry)
@@ -735,6 +781,16 @@ class SweepService:
         queue the load now so placement takes a RAM-warm dataset."""
         spec = self._data_spec(entry)
         if spec:
+            # The queued instant names the SUBMISSION; the store's
+            # dataset_prefetch_end names the SPEC — the trace builder
+            # joins the two into the dataset_prefetch span.
+            _emit(
+                "dataset_prefetch_queued",
+                trial_id=entry.trial_id,
+                sub_id=entry.sub_id,
+                spec=spec,
+                trace=entry.trace_id,
+            )
             self.store.prefetch(spec)
 
     def _take_dataset(self, spec: str):
@@ -837,6 +893,11 @@ class SweepService:
         self.ledger.attempt_start(
             e.trial_id, self.chashes[e.trial_id], self.attempts[e.trial_id]
         )
+        # ONE attribution object per placement: installed here for the
+        # construction-time compiles, and per dispatch from _Active
+        # (a second copy could silently diverge from this one).
+        trace_attr = ttrace.make_attribution([(e.trial_id, e.trace_id)])
+        ttrace.set_attribution(trace_attr)
         try:
             stage_meshes = [
                 self._mesh_for(start, size) for start, size in blocks
@@ -857,6 +918,8 @@ class SweepService:
             free_all()
             self._setup_failed([e], exc)
             return
+        finally:
+            ttrace.set_attribution(None)
         ap = _Active(
             placement_id=p.placement_id,
             start=p.start,
@@ -869,10 +932,13 @@ class SweepService:
             construct_s=time.perf_counter() - t0,
             tenants=(e.tenant,),
             blocks=blocks,
+            trace_attr=trace_attr,
         )
         self.active[p.placement_id] = ap
         self._note_unblock(e)
-        self.queue_wait.observe(max(0.0, now - e.submit_ts))
+        wait = max(0.0, now - e.submit_ts)
+        self.queue_wait.observe(wait, exemplar=e.sub_id)
+        self.slo.observe_latency("queue_wait", wait, ts=now)
         self.queue.placed(
             e.sub_id,
             trial_id=e.trial_id,
@@ -896,6 +962,7 @@ class SweepService:
             pipelined=True,
             blocks=[[int(s), int(n)] for s, n in blocks],
             queue_wait_s=round(max(0.0, now - e.submit_ts), 4),
+            trace=e.trace_id,
         )
 
     def _start_placement(self, p: Placement) -> None:
@@ -962,6 +1029,12 @@ class SweepService:
             self.pool.free(p.start, p.size)
             return
         stacked = len(members) >= 2
+        # Compile-registry events fired during construction (init
+        # programs, AOT claims) ride every member's trace.
+        trace_attr = ttrace.make_attribution(
+            [(e.trial_id, e.trace_id) for e in members]
+        )
+        ttrace.set_attribution(trace_attr)
         try:
             if stacked:
                 run = _StackedBucketRun(
@@ -1007,6 +1080,8 @@ class SweepService:
             self.pool.free(p.start, p.size)
             self._setup_failed(members, exc)
             return
+        finally:
+            ttrace.set_attribution(None)
         ap = _Active(
             placement_id=p.placement_id,
             start=p.start,
@@ -1018,11 +1093,14 @@ class SweepService:
             place_ts=now,
             construct_s=time.perf_counter() - t0,
             tenants=tuple(sorted({e.tenant for e in members})),
+            trace_attr=trace_attr,
         )
         self.active[p.placement_id] = ap
         for e in members:
             self._note_unblock(e)
-            self.queue_wait.observe(max(0.0, now - e.submit_ts))
+            wait = max(0.0, now - e.submit_ts)
+            self.queue_wait.observe(wait, exemplar=e.sub_id)
+            self.slo.observe_latency("queue_wait", wait, ts=now)
             self.queue.placed(
                 e.sub_id,
                 trial_id=e.trial_id,
@@ -1043,6 +1121,7 @@ class SweepService:
                 lanes=len(members),
                 stacked=stacked,
                 queue_wait_s=round(max(0.0, now - e.submit_ts), 4),
+                trace=e.trace_id,
             )
 
     def _note_unblock(self, e: PendingTrial) -> None:
@@ -1155,6 +1234,7 @@ class SweepService:
                 self._deadline_hits += 1
             else:
                 self._deadline_misses += 1
+            self.slo.observe_event("deadline", hit, ts=now)
             _emit(
                 "deadline_hit" if hit else "deadline_miss",
                 trial_id=tid,
@@ -1162,6 +1242,7 @@ class SweepService:
                 tenant=entry.tenant,
                 status=status,
                 margin_s=round(entry.deadline_ts - now, 3),
+                trace=entry.trace_id,
             )
         _emit(
             "submission_settled",
@@ -1170,6 +1251,7 @@ class SweepService:
             tenant=entry.tenant,
             status=status,
             wait_to_settle_s=round(now - entry.submit_ts, 3),
+            trace=entry.trace_id,
         )
 
     # -- stepping -----------------------------------------------------
@@ -1182,11 +1264,19 @@ class SweepService:
     def _step_actives(self) -> bool:
         """One cooperative dispatch per live placement; returns whether
         any placement made progress (drives the idle sleep)."""
+        from multidisttorch_tpu.telemetry.events import get_bus
+
         progressed = False
+        # Trace attribution around each dispatch (compile claims fire
+        # inside the generators): prebuilt per placement, installed
+        # only when telemetry is on — the off path touches nothing.
+        tracing = get_bus() is not None
         for pid in list(self.active):
             ap = self.active.get(pid)
             if ap is None:
                 continue
+            if tracing:
+                ttrace.set_attribution(ap.trace_attr)
             try:
                 next(ap.gen)
                 progressed = True
@@ -1197,15 +1287,23 @@ class SweepService:
                     # cooperative step returning (run construction +
                     # state init + compile claim + first dispatch) —
                     # the "submission is actually training" moment.
+                    # Exemplar = a member's submission id, so a bad
+                    # percentile bucket names the trace that caused it.
+                    lat = max(0.0, time.time() - ap.place_ts)
                     self.placement_latency.observe(
-                        max(0.0, time.time() - ap.place_ts)
+                        lat,
+                        exemplar=next(iter(ap.entries.values())).sub_id,
                     )
+                    self.slo.observe_latency("placement_latency", lat)
             except StopIteration:
                 self._completed(ap)
                 progressed = True
             except Exception as exc:  # noqa: BLE001 — failure isolation
                 self._placement_failed(ap, exc)
                 progressed = True
+            finally:
+                if tracing:
+                    ttrace.set_attribution(None)
         return progressed
 
     def _completed(self, ap: _Active) -> None:
@@ -1706,11 +1804,20 @@ class SweepService:
         stats = squeue.QueueStats.of(folded)
         frag = self.pool.fragmentation()
         self._frag_max = max(self._frag_max, frag)
+        tenant_books = finalize_tenant_goodput(self._tenant_fold)
+        # SLO sampling at the books cadence: per-tenant goodput
+        # against the floor, then one evaluation pass (edge-triggered
+        # slo_alert events ride the bus from inside evaluate()).
+        for t, b in tenant_books.items():
+            self.slo.observe_gauge(
+                "tenant_goodput", b.get("goodput"), label=t
+            )
         return {
             "generated_ts": time.time(),
             "service_dir": self.service_dir,
             "slices": self.n_slices,
             "devices_per_slice": self._devs_per_slice,
+            "fence_epoch": self.fence_epoch,
             "queue": {
                 "by_state": dict(sorted(stats.by_state.items())),
                 "by_tenant": {
@@ -1720,10 +1827,11 @@ class SweepService:
                 "pending_now": self.sched.pending_count(),
                 "active_placements": len(self.active),
             },
-            "tenants": finalize_tenant_goodput(self._tenant_fold),
+            "tenants": tenant_books,
             "fair_share": self.sched.fair_share_report(),
             "queue_wait": self.queue_wait.stats(),
             "placement_latency": self.placement_latency.stats(),
+            "slo": self.slo.evaluate(),
             "fragmentation": {
                 "now": round(frag, 4),
                 "max": round(self._frag_max, 4),

@@ -211,6 +211,10 @@ class PendingTrial:
     # position IS the contract (a pinned victim beaten to its
     # relocation target would waste the whole defrag window).
     front_barrier: bool = False
+    # End-to-end trace id (telemetry/trace.py): minted at submit,
+    # carried so placement-time events and ledger attempts ride it.
+    # Opaque to the scheduler.
+    trace_id: Optional[str] = None
 
 
 @dataclass

@@ -73,7 +73,7 @@ class Histogram:
     Prometheus-style estimate.
     """
 
-    __slots__ = ("bounds", "counts", "count", "sum", "max")
+    __slots__ = ("bounds", "counts", "count", "sum", "max", "exemplars")
 
     def __init__(self, bounds=DEFAULT_TIME_BUCKETS):
         self.bounds = tuple(float(b) for b in bounds)
@@ -81,29 +81,60 @@ class Histogram:
         self.count = 0
         self.sum = 0.0
         self.max = 0.0
+        # bucket index -> (value, id) of the WORST observation that
+        # landed there (Prometheus-exemplar shape): the service books
+        # pass a submission id, so a bad p99 bucket names the exact
+        # trace behind it. Populated only when callers pass exemplar=
+        # — plain observes pay one None check.
+        self.exemplars: dict = {}
 
-    def observe(self, v: float) -> None:
-        self.counts[bisect.bisect_left(self.bounds, v)] += 1
+    def observe(self, v: float, exemplar=None) -> None:
+        i = bisect.bisect_left(self.bounds, v)
+        self.counts[i] += 1
         self.count += 1
         self.sum += v
         if v > self.max:
             self.max = v
+        if exemplar is not None:
+            cur = self.exemplars.get(i)
+            if cur is None or v > cur[0]:
+                self.exemplars[i] = (v, exemplar)
 
-    def percentile(self, p: float) -> float:
-        if self.count == 0:
-            return 0.0
+    def _percentile_bucket(self, p: float) -> int:
         rank = p / 100.0 * self.count
         seen = 0
         for i, c in enumerate(self.counts):
             seen += c
             if seen >= rank and c:
-                return self.bounds[i] if i < len(self.bounds) else self.max
-        return self.max
+                return i
+        return len(self.counts) - 1
+
+    def percentile(self, p: float) -> float:
+        if self.count == 0:
+            return 0.0
+        i = self._percentile_bucket(p)
+        return self.bounds[i] if i < len(self.bounds) else self.max
+
+    def percentile_exemplar(self, p: float):
+        """The worst-offender exemplar of the bucket the ``p``-th
+        percentile falls in (or, if that bucket collected none, the
+        highest exemplar-carrying bucket at or below it) — the
+        "jump from a bad percentile to its trace" hook. ``None`` when
+        no exemplars were ever recorded."""
+        if self.count == 0 or not self.exemplars:
+            return None
+        i = self._percentile_bucket(p)
+        for j in range(i, -1, -1):
+            got = self.exemplars.get(j)
+            if got is not None:
+                v, ident = got
+                return {"value_s": v, "id": ident}
+        return None
 
     def stats(self) -> dict:
         if self.count == 0:
             return {"count": 0}
-        return {
+        out = {
             "count": self.count,
             "sum_s": self.sum,
             "mean_s": self.sum / self.count,
@@ -112,6 +143,19 @@ class Histogram:
             "p99_s": self.percentile(99),
             "max_s": self.max,
         }
+        if self.exemplars:
+            # Absent when no caller passed exemplars: pre-exemplar
+            # stats blocks stay byte-identical.
+            out["p99_exemplar"] = self.percentile_exemplar(99)
+            out["exemplars"] = {
+                (
+                    str(self.bounds[i])
+                    if i < len(self.bounds)
+                    else "+Inf"
+                ): {"value_s": round(v, 6), "id": ident}
+                for i, (v, ident) in sorted(self.exemplars.items())
+            }
+        return out
 
 
 class StepSeries:

@@ -123,9 +123,22 @@ def main(argv=None) -> int:
     from multidisttorch_tpu import telemetry
     from multidisttorch_tpu.parallel import membership
 
-    if not telemetry.enabled():
-        telemetry.configure(os.path.join(args.service_dir, "telemetry"))
     slot = os.environ.get("MDT_HOST_SLOT")
+    if not telemetry.enabled():
+        # One sink per replica process: two fabric replicas over the
+        # same root used to open (and truncate) the SAME events.jsonl
+        # and interleave destructively — the per-replica subdir keeps
+        # each stream whole, and the trace/fleet discovery rule
+        # (events*.jsonl at any depth under telemetry/) finds both.
+        tel_dir = os.path.join(args.service_dir, "telemetry")
+        rep = (
+            args.replica
+            if args.replica is not None
+            else (int(slot) if slot is not None else None)
+        )
+        if args.fabric and rep is not None:
+            tel_dir = os.path.join(tel_dir, f"replica-{int(rep)}")
+        telemetry.configure(tel_dir)
     if slot is None and args.fabric and args.replica is not None:
         # A fabric replica always heartbeats: the console's replica
         # health and the supervisor's staleness verdict both read the

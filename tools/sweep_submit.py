@@ -95,20 +95,32 @@ def main(argv=None) -> int:
 
     client = SweepClient(args.service_dir, tenant=args.tenant)
     ids = []
+    traces = {}
     for k in range(args.count):
-        ids.append(
-            client.submit(
-                {**cfg, "seed": args.seed + k},
-                priority=args.priority,
-                size=args.size,
-                deadline_s=args.deadline,
+        sid = client.submit(
+            {**cfg, "seed": args.seed + k},
+            priority=args.priority,
+            size=args.size,
+            deadline_s=args.deadline,
+        )
+        ids.append(sid)
+        # The trace id minted with the submission: the handle
+        # `tools/sweep_trace.py` (and the Perfetto export) joins a
+        # whole lifecycle on (docs/OBSERVABILITY.md "Tracing & SLOs").
+        traces[sid] = client.last_submission.trace_id
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "submitted": ids,
+                    "tenant": args.tenant,
+                    "traces": traces,
+                }
             )
         )
-    if args.json:
-        print(json.dumps({"submitted": ids, "tenant": args.tenant}))
     else:
         for s in ids:
-            print(s)
+            print(f"{s}  trace={traces[s]}")
     if not args.wait:
         return 0
     final = client.wait(ids, timeout_s=args.wait_timeout)

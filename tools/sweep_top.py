@@ -644,6 +644,38 @@ def fabric_panel(service_dir: str, *, deadline_s: float = 3.0) -> str:
     return "\n".join(lines)
 
 
+def render_slo_panel(slo: dict) -> str:
+    """The SLO/error-budget scoreboard (docs/OBSERVABILITY.md
+    "Tracing & SLOs"): one row per (objective, label) with compliance
+    vs target, budget spent, and the multi-window burn rates — ALERT
+    when both windows burn past their factors."""
+    rows = []
+    for name, evals in sorted((slo.get("slos") or {}).items()):
+        for ev in evals:
+            burn = ev.get("burn") or {}
+            burn_s = " ".join(
+                f"{w}s:{b['burn']}" for w, b in sorted(burn.items())
+            )
+            comp = ev.get("compliance")
+            rows.append(
+                [
+                    name + (f"[{ev['label']}]" if ev.get("label") else ""),
+                    f"{comp:.4f}" if comp is not None else "-",
+                    f"{ev.get('objective'):.2f}",
+                    f"{ev.get('budget_spent_frac', 0):.2f}",
+                    burn_s or "-",
+                    "ALERT" if ev.get("alerting") else (
+                        "ok" if ev.get("met") else "MISS"
+                    ),
+                ]
+            )
+    head = "slo  " + ("(budget spent = error budget consumed, 1.0 = gone)")
+    table = fmt_table(
+        rows, ["objective", "compliance", "target", "spent", "burn", ""]
+    )
+    return head + "\n" + table
+
+
 def render_service(folded, books, state, service_dir: str) -> str:
     """Tenant/queue panel over a service directory (docs/SERVICE.md):
     queue depth by state, per-tenant goodput + fair-share vs weight,
@@ -700,12 +732,20 @@ def render_service(folded, books, state, service_dir: str) -> str:
     ):
         h = books.get(key) or {}
         if h.get("count"):
+            # p99 exemplar: the worst-offender submission behind the
+            # percentile — `sweep_trace <dir> <id>` renders its trace.
+            ex = h.get("p99_exemplar") or {}
+            ex_s = f"  worst {ex['id']}" if ex.get("id") else ""
             lines.append(
                 f"{label}  n {h['count']}  p50 "
                 f"{fmt_duration(h.get('p50_s'))}  p99 "
                 f"{fmt_duration(h.get('p99_s'))}  max "
-                f"{fmt_duration(h.get('max_s'))}"
+                f"{fmt_duration(h.get('max_s'))}{ex_s}"
             )
+    slo = books.get("slo") or {}
+    if slo.get("slos"):
+        lines.append("")
+        lines.append(render_slo_panel(slo))
     lines.append("")
     tenants = books.get("tenants") or {}
     fair = books.get("fair_share") or {}
