@@ -2164,6 +2164,17 @@ def main():
         "artifacts/bench_fabric_*.json)",
     )
     parser.add_argument(
+        "--ckpt", action="store_true",
+        help="run the checkpoint data-plane drill (docs/RESILIENCE.md "
+        "\"Checkpoint format v2\"): v1<->v2 bitwise restore parity "
+        "across classic/stacked/ZeRO/pipelined trials, incremental "
+        "delta ratio < 0.5x full-model bytes on a multi-epoch "
+        "fine-tune cadence, and the snapshot-fast drain — victim "
+        "slices freed without blocking on persist, ledger `preempted` "
+        "only after the persist lands, RAM-snapshot re-place (banks "
+        "artifacts/bench_ckpt_*.json)",
+    )
+    parser.add_argument(
         "--telemetry-ab", action="store_true",
         help="run ONLY the standing telemetry overhead A/B (the "
         "stacked K=4 dispatch loop, OFF vs ON with device books, "
@@ -2184,16 +2195,16 @@ def main():
                      args.lm, args.suite, args.decode, args.stacked,
                      args.chaos, args.chaos_mh, args.coldstart,
                      args.pbt, args.service, args.dataplane,
-                     args.pipeline, args.fabric,
+                     args.pipeline, args.fabric, args.ckpt,
                      args.telemetry_ab)) > 1:
         parser.error("--concurrency/--to-elbo/--loader/--lm/--decode/"
                      "--suite/--stacked/--chaos/--chaos-mh/--coldstart/"
                      "--pbt/--service/--dataplane/--pipeline/--fabric/"
-                     "--telemetry-ab are mutually exclusive")
+                     "--ckpt/--telemetry-ab are mutually exclusive")
 
     if (args.stacked or args.chaos or args.chaos_mh or args.pbt
             or args.service or args.dataplane or args.pipeline
-            or args.fabric or args.telemetry_ab) and \
+            or args.fabric or args.ckpt or args.telemetry_ab) and \
             "xla_force_host_platform_device_count" not in (
         os.environ.get("XLA_FLAGS", "")
     ):
@@ -2630,6 +2641,67 @@ def main():
                 }
             )
         )
+        return
+
+    if args.ckpt:
+        import tempfile
+
+        from multidisttorch_tpu.service.ckpt_drill import run_ckpt_bench
+
+        r = run_ckpt_bench(tempfile.mkdtemp(prefix="bench_ckpt_"))
+        r["backend"] = backend
+        banked = None
+        try:
+            os.makedirs("artifacts", exist_ok=True)
+            stamp = time.strftime("%Y%m%d_%H%M%S", time.gmtime())
+            platform = backend.get("platform", "cpu")
+            banked = f"artifacts/bench_ckpt_{platform}_{stamp}.json"
+            tmp = banked + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(r, f, indent=1)
+            os.replace(tmp, banked)
+            latest = "artifacts/bench_ckpt_latest.json"
+            with open(latest + ".tmp", "w") as f:
+                json.dump({**r, "banked_as": banked}, f, indent=1)
+            os.replace(latest + ".tmp", latest)
+        except OSError as e:
+            print(f"artifact banking failed: {e!r}", file=sys.stderr)
+            banked = None
+        prim = r["drain_primitive"]
+        print(
+            json.dumps(
+                {
+                    "metric": "ckpt_snapshot_drain_to_slices_freed_s",
+                    "value": prim["arms"]["snapshot_v2"][
+                        "drain_to_slices_freed_s"
+                    ],
+                    "vs_v1_full_persist_drain_s": prim["arms"][
+                        "join_v1"
+                    ]["drain_to_slices_freed_s"],
+                    "speedup": prim["speedup"],
+                    "unit": "seconds (wall ratios recorded, not "
+                    "gated, on shared runners; the structural gates "
+                    "below are what CI enforces)",
+                    # acceptance: v2 restores bitwise-identical to v1
+                    # across all four trial flavors; incremental saves
+                    # < 0.5x full-model bytes on the fine-tune delta
+                    # run; drain frees slices without blocking on
+                    # persist + ledger honesty + RAM re-place.
+                    **r["gates"],
+                    "delta_ratio": r["delta"]["finetune"][
+                        "delta_ratio_mean"
+                    ],
+                    "full_adam_contrast_ratio": r["delta"][
+                        "full_adam_contrast"
+                    ]["delta_ratio_mean"],
+                    "ok": r["ok"],
+                    "banked": banked,
+                },
+                indent=2,
+            )
+        )
+        if not r["ok"]:
+            sys.exit(1)
         return
 
     if args.fabric:

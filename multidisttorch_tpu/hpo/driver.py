@@ -70,9 +70,12 @@ from multidisttorch_tpu.hpo.supervision import (
 from multidisttorch_tpu.models.vae import VAE
 from multidisttorch_tpu.parallel.mesh import TrialMesh, setup_groups
 from multidisttorch_tpu.train.checkpoint import (
+    RAM_SNAPSHOT,
+    default_format,
     restore_latest_valid,
     restore_state,
     save_state,
+    snapshot_cache,
 )
 from multidisttorch_tpu.train.guards import DivergenceError, check_finite
 from multidisttorch_tpu.train.steps import (
@@ -328,6 +331,8 @@ class _TrialRun:
         wedge_timeout_s: Optional[float] = None,
         injector=None,  # faults.inject.FaultInjector | None
         ckpt_keep_last: int = 1,
+        ckpt_format: Optional[str] = None,
+        ram_restore: bool = False,
         attempt: int = 1,
     ):
         if cfg.fused_steps < 1:
@@ -397,6 +402,21 @@ class _TrialRun:
         # take — see faults/inject.py for the hook contract.
         self._injector = injector
         self._ckpt_keep_last = ckpt_keep_last
+        # Checkpoint data-plane format (docs/RESILIENCE.md "Checkpoint
+        # format v2"): the driver writes v2 chunked manifests by
+        # default (MDT_CKPT_FORMAT=v1 opts back into full-msgpack);
+        # restore always sniffs per file, so a v1 history under a v2
+        # primary resumes fine.
+        self._ckpt_format = (
+            ckpt_format if ckpt_format is not None else default_format()
+        )
+        # RAM-snapshot restore is an explicit opt-in (the service's
+        # same-process re-place after a snapshot drain): supervised
+        # retry drills outside the service keep pure disk semantics —
+        # a chaos test that corrupts the on-disk history must observe
+        # the scan-back degrade, not a warm cache.
+        self._ram_restore = bool(ram_restore)
+        self._last_ckpt_stats: dict = {}
         # Optimizer-step cursor mirrored as an attribute so the
         # injection hooks (closures built below, called from inside the
         # compiled-step wrappers) always see the current step.
@@ -481,13 +501,19 @@ class _TrialRun:
 
             self.state, self._state_sh = place_zero_state(trial, self.state)
         # Checkpointing a weight-sharded state: serialization needs the
-        # whole array on the writer host, but on a spanning submesh the
-        # writer holds only its shards. The gather-to-replicated below
+        # whole array on the writer host. On a PROCESS-SPANNING submesh
+        # the writer holds only its shards, so a gather-to-replicated
         # is DISPATCHED by every owner (uniform SPMD program — the same
         # rule as every other step); only the fetch stays writer-gated.
+        # Single-controller sharded states (ZeRO, TP, FSDP) skip the
+        # gather entirely under the v2 format — every shard is locally
+        # addressable, the host fetch assembles them without a device
+        # collective, and the manifest records the NamedSharding layout
+        # the state trained under (the sharded-native save path).
         self._gather_state = (
             jax.jit(lambda s: s, out_shardings=trial.replicated_sharding)
             if self._state_sh is not None
+            and (self._ckpt_format == "v1" or trial.spans_processes)
             else None
         )
         # Memory books (docs/PARALLEL.md): the analytic per-device
@@ -687,6 +713,44 @@ class _TrialRun:
         """
         def accept(meta: dict) -> bool:
             return not self._config_mismatch(meta)
+
+        # Warm re-place (docs/RESILIENCE.md "Snapshot-fast drain"): a
+        # preempted trial re-placed in the SAME process restores from
+        # the still-warm RAM snapshot — no chunk reads, no msgpack
+        # decode. The cache entry is written at the same device→host
+        # fetch that feeds the durable write, so it is never older
+        # than the newest disk candidate for this path; config-match
+        # gates it exactly like a disk candidate's sidecar.
+        snap = (
+            snapshot_cache().get(self._ckpt_path)
+            if self._ram_restore
+            else None
+        )
+        if snap is not None:
+            host_state, meta = snap
+            if accept(meta) and int(meta.get("completed_epochs", 0)) >= 1:
+                try:
+                    restored = self.trial.device_put(
+                        host_state, self._state_sh
+                    )
+                except Exception:  # noqa: BLE001 — fall back to disk
+                    restored = None
+                if restored is not None:
+                    from multidisttorch_tpu.train.checkpoint import _count
+
+                    _count(restores=1, restores_ram=1)
+                    bus = get_bus()
+                    if bus is not None:
+                        bus.emit(
+                            "ckpt_restore",
+                            group_id=self.trial.group_id,
+                            path=RAM_SNAPSHOT,
+                            format="ram",
+                            trial_id=self.cfg.trial_id,
+                            step=meta.get("step"),
+                        )
+                    return restored, dict(meta), RAM_SNAPSHOT
+            snapshot_cache().drop(self._ckpt_path)
 
         if not (jax.process_count() > 1 and self.trial.spans_processes):
             return restore_latest_valid(
@@ -984,6 +1048,14 @@ class _TrialRun:
                 self._ckpt_path,
                 metadata=meta,
                 keep_last=self._ckpt_keep_last,
+                format=self._ckpt_format,
+                # The layout record describes what was SNAPSHOTTED: a
+                # gathered (replicated) snapshot must not claim the
+                # live state's sharded layout.
+                layouts=(
+                    self._state_sh if self._gather_state is None else None
+                ),
+                stats_out=self._last_ckpt_stats,
             )
             self.result.checkpoint = self._ckpt_path
             if self._injector is not None:
@@ -1008,6 +1080,13 @@ class _TrialRun:
                 f"trial {self.cfg.trial_id}: checkpoint write to "
                 f"{self._ckpt_path} failed"
             ) from e
+
+    def _ckpt_idle(self) -> bool:
+        """No persist in flight (non-blocking — the snapshot-fast
+        drain's poll; :meth:`_join_ckpt` is the blocking/raising
+        sibling)."""
+        t = self._ckpt_thread
+        return t is None or not t.is_alive()
 
     def run(self) -> Iterator[None]:
         cfg = self.cfg
@@ -1296,6 +1375,7 @@ class _TrialRun:
                     # its own buffer in the sharded case).
                     jax.tree.map(lambda x: x.copy_to_host_async(), snap)
                     yield
+                    _snap_t0 = time.perf_counter()
                     host_state = self._wedged_fetch(
                         lambda: jax.device_get(snap),
                         f"epoch {epoch} checkpoint snapshot fetch",
@@ -1321,6 +1401,29 @@ class _TrialRun:
                         "step": int(host_state.step),
                         "history": list(self.result.history),
                     }
+                    # The device→host snapshot is the drain boundary
+                    # (docs/RESILIENCE.md "Snapshot-fast drain"): once
+                    # it lands in the RAM cache, a preemption can free
+                    # this trial's slices and a same-process re-place
+                    # can restore without touching disk — persistence
+                    # below runs behind. Gated on the same opt-in as
+                    # the read side: a standalone run_hpo must not pin
+                    # host copies of large states nothing will read.
+                    if self._ram_restore:
+                        snapshot_cache().put(
+                            self._ckpt_path, host_state, meta
+                        )
+                    if bus is not None:
+                        bus.emit(
+                            "ckpt_snapshot",
+                            trial_id=cfg.trial_id,
+                            group_id=self.trial.group_id,
+                            step=int(host_state.step),
+                            epoch=epoch,
+                            wall_s=round(
+                                time.perf_counter() - _snap_t0, 6
+                            ),
+                        )
                     self._join_ckpt()
                     self._ckpt_thread = threading.Thread(
                         target=self._write_ckpt,
@@ -1603,6 +1706,7 @@ class _StackedBucketRun:
         chashes: Optional[dict] = None,  # config index -> config hash
         infra_fails: Optional[dict] = None,  # config index -> infra failures
         datasets: Optional[dict] = None,  # config index -> Dataset
+        ckpt_format: Optional[str] = None,
     ):
         template = items[0][1]
         for _, cfg in items:
@@ -1635,6 +1739,9 @@ class _StackedBucketRun:
         self.queue: list[tuple[int, TrialConfig]] = list(items)
         self.results: dict[int, TrialResult] = {}
         self._save_checkpoint = save_checkpoint
+        self._ckpt_format = (
+            ckpt_format if ckpt_format is not None else default_format()
+        )
         self._verbose = verbose
         self._host_syncs = 0
         self._is_writer = trial.is_writer_process
@@ -2107,6 +2214,13 @@ class _StackedBucketRun:
                         "step": int(host_state.step),
                         "history": list(lane["history"]),
                     },
+                    # Retired lanes ride the checkpoint data plane too:
+                    # same-bucket lanes share one trial-dir-scoped
+                    # chunk store per trial, and identical warm-start
+                    # chunks dedup across retirements. Same format knob
+                    # as the classic runner (the service threads its
+                    # configured format through).
+                    format=self._ckpt_format,
                 )
                 result.checkpoint = ckpt
             os.makedirs(lane_out_dir, exist_ok=True)

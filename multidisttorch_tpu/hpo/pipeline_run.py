@@ -53,8 +53,10 @@ from multidisttorch_tpu.telemetry import device as tele_device
 from multidisttorch_tpu.telemetry.events import get_bus
 from multidisttorch_tpu.telemetry.metrics import get_registry
 from multidisttorch_tpu.train.checkpoint import (
+    default_format,
     restore_state,
     save_state,
+    snapshot_cache,
     valid_candidates_by_step,
 )
 from multidisttorch_tpu.train.guards import check_finite
@@ -93,6 +95,8 @@ class _PipelineTrialRun:
         verbose: bool = False,
         resume=False,  # False | "scan"
         ckpt_keep_last: int = 1,
+        ckpt_format: Optional[str] = None,
+        ram_restore: bool = False,
         attempt: int = 1,
     ):
         S = len(stage_meshes)
@@ -144,6 +148,12 @@ class _PipelineTrialRun:
         self._save_checkpoint = save_checkpoint
         self._verbose = verbose
         self._ckpt_keep_last = ckpt_keep_last
+        self._ckpt_format = (
+            ckpt_format if ckpt_format is not None else default_format()
+        )
+        # Same-process warm re-place only (the classic driver's rule):
+        # disk drills must observe disk.
+        self._ram_restore = bool(ram_restore)
         self._attempt = attempt
         self._host_syncs = 0
         self._step_no = 0
@@ -241,6 +251,55 @@ class _PipelineTrialRun:
         stage can locally verify (CRC + config match); one stage's torn
         file pulls the whole pipeline back together. Returns completed
         epochs, or None for scratch."""
+        # Warm re-place: every stage's RAM snapshot present at one
+        # agreed step (they are written together) restores without
+        # touching disk — the pipelined analog of the classic driver's
+        # snapshot-cache fast path.
+        snaps = (
+            [snapshot_cache().get(p) for p in self._ckpt_paths]
+            if self._ram_restore
+            else [None]
+        )
+        if all(s is not None for s in snaps):
+            metas = [m for _, m in snaps]
+            steps = {int(m.get("step", -1)) for m in metas}
+            usable = (
+                len(steps) == 1
+                and self._accept_meta(metas[0])
+                and int(metas[0].get("completed_epochs", 0)) >= 1
+            )
+            if usable:
+                try:
+                    states = [
+                        self.stage_meshes[s].device_put(
+                            host, self.pipe.state_shardings[s]
+                        )
+                        for s, (host, _) in enumerate(snaps)
+                    ]
+                except Exception:  # noqa: BLE001 — fall back to disk
+                    states = None
+                if states is not None:
+                    from multidisttorch_tpu.train.checkpoint import _count
+
+                    self.pipe.states = states
+                    self.result.checkpoint = self._ckpt_paths[0]
+                    self._adopt_history(metas[0])
+                    _count(restores=1, restores_ram=1)
+                    _emit(
+                        "ckpt_restore",
+                        trial_id=self.cfg.trial_id,
+                        group_id=self.trial.group_id,
+                        path="<ram-snapshot>",
+                        format="ram",
+                        step=metas[0].get("step"),
+                    )
+                    return int(metas[0].get("completed_epochs", 0))
+            else:
+                # Stale/rejected snapshots squat in the bounded LRU and
+                # re-reject on every retry — drop them (the classic
+                # driver's rule).
+                for p in self._ckpt_paths:
+                    snapshot_cache().drop(p)
         common: Optional[set] = None
         cands = []
         for path in self._ckpt_paths:
@@ -293,12 +352,20 @@ class _PipelineTrialRun:
 
     def _write_ckpt(self, host_states, meta: dict) -> None:
         try:
-            for path, host_state in zip(self._ckpt_paths, host_states):
+            for s, (path, host_state) in enumerate(
+                zip(self._ckpt_paths, host_states)
+            ):
                 save_state(
                     host_state,
                     path,
                     metadata=meta,
                     keep_last=self._ckpt_keep_last,
+                    # Per-stage manifests: every stage's family shares
+                    # the trial dir's ONE chunk store, and each records
+                    # its stage's NamedSharding layout (a zero_update
+                    # stage's sharded moments stay sharded on disk).
+                    format=self._ckpt_format,
+                    layouts=self.pipe.state_shardings[s],
                 )
             self.result.checkpoint = self._ckpt_paths[0]
         except BaseException as e:  # re-raised at the next join
@@ -314,6 +381,12 @@ class _PipelineTrialRun:
                 f"pipelined trial {self.cfg.trial_id}: stage checkpoint "
                 "write failed"
             ) from e
+
+    def _ckpt_idle(self) -> bool:
+        """No stage persist in flight (the snapshot-fast drain's
+        non-blocking poll)."""
+        t = self._ckpt_thread
+        return t is None or not t.is_alive()
 
     # -- books --------------------------------------------------------
 
@@ -495,6 +568,7 @@ class _PipelineTrialRun:
                 # shards are all addressable single-controller), start
                 # the device→host copies async, then hand the
                 # serialize+write to the background thread.
+                _snap_t0 = time.perf_counter()
                 snaps = [
                     jax.device_get(st) for st in self.pipe.states
                 ]
@@ -505,6 +579,22 @@ class _PipelineTrialRun:
                     "history": list(self.result.history),
                     "pipeline_stage": True,
                 }
+                # Snapshot boundary per stage (the drain contract): a
+                # same-process re-place restores every stage from RAM.
+                # Same opt-in gate as the read side — no host-copy
+                # retention outside the service path.
+                if self._ram_restore:
+                    for path, host_state in zip(self._ckpt_paths, snaps):
+                        snapshot_cache().put(path, host_state, meta)
+                _emit(
+                    "ckpt_snapshot",
+                    trial_id=cfg.trial_id,
+                    group_id=self.trial.group_id,
+                    step=int(snaps[0].step),
+                    epoch=epoch,
+                    stages=len(snaps),
+                    wall_s=round(time.perf_counter() - _snap_t0, 6),
+                )
                 self._join_ckpt()
                 self._ckpt_thread = threading.Thread(
                     target=self._write_ckpt,

@@ -146,6 +146,28 @@ def place_zero_state(
     return trial.device_put(state, sh), sh
 
 
+def describe_shardings(shardings: Any) -> dict:
+    """Flatten a shardings pytree into ``{leaf-key: spec-string}`` —
+    the checkpoint manifest's layout record (docs/RESILIENCE.md
+    "Checkpoint format v2"): the on-disk format names the
+    ``NamedSharding`` layout the state trained under, so a reader (or
+    a restore-parity check) can see which leaves the runtime sharded
+    without reconstructing the mesh. The same flattening rule as the
+    manifest builder's, so keys line up with manifest leaf keys."""
+    from flax import serialization
+
+    from multidisttorch_tpu.train.ckpt_store import _flatten_state_dict
+
+    out: dict[str, str] = {}
+    for key, sh in _flatten_state_dict(
+        serialization.to_state_dict(shardings)
+    ):
+        spec = getattr(sh, "spec", None)
+        if spec is not None:
+            out[key] = str(spec)
+    return out
+
+
 def optimizer_state_bytes(state: Any) -> dict:
     """Analytic optimizer-memory book from a placed TrainState:
     ``per_device_bytes`` (what one chip actually holds, from each opt

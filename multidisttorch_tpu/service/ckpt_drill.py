@@ -1,0 +1,648 @@
+"""Checkpoint data-plane acceptance drills (``bench.py --ckpt``).
+
+Three phases, each a gate in the banked artifact
+(docs/RESILIENCE.md "Checkpoint format v2"):
+
+1. **Restore parity** — every trial flavor (classic, stacked lane,
+   ZeRO sharded-update, MPMD pipelined stage) is trained TWICE from
+   one seed, once writing v1 full-msgpack checkpoints and once writing
+   v2 chunked manifests; the two on-disk checkpoints must decode to
+   BITWISE-identical state (training is bit-reproducible on this
+   toolchain, so any drift is the format's fault). Gate: all flavors
+   bit-identical, every leaf, dtype included.
+
+2. **Incremental delta** — a multi-epoch fine-tune cadence (train the
+   latent head, everything else frozen — Adam's zero-grad moments stay
+   bitwise stable) saved every epoch under v2: unchanged chunks are
+   referenced, not rewritten. Gate: mean per-save written/total ratio
+   after the first save < 0.5 (the all-params full-Adam contrast is
+   recorded, not gated — every chunk changes, ratio ~1.0).
+
+3. **Snapshot-fast drain** — with a deterministic persist delay
+   (``MDT_CKPT_PERSIST_DELAY_S``) making the write cost visible, the
+   drain primitive is measured in both modes against a placement with
+   a checkpoint write IN FLIGHT: the snapshot drain frees the victim's
+   slices without joining the write; the legacy (v1-era) join drain
+   blocks on the full persist. Gate: snapshot drain-to-slices-freed
+   strictly faster. The end-to-end half runs the deadline-preemption
+   drill under snapshot drain: the deadline whale places and completes
+   inside its deadline, the ledger records ``preempted`` only AFTER
+   each victim's background persist lands (checked LIVE, mid-drill),
+   and the victims resume — same-process, so from the RAM snapshot —
+   and complete.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Optional
+
+from multidisttorch_tpu.service import queue as squeue
+
+PERSIST_DELAY_ENV = "MDT_CKPT_PERSIST_DELAY_S"
+
+
+def _flatten(sd, prefix=""):
+    from multidisttorch_tpu.train.ckpt_store import _flatten_state_dict
+
+    return _flatten_state_dict(sd, prefix)
+
+
+def _bitwise_equal(dict_a, dict_b) -> tuple[bool, list]:
+    """Compare two nested state_dicts leaf-by-leaf: values AND dtypes."""
+    import numpy as np
+
+    fa = dict(_flatten(dict_a))
+    fb = dict(_flatten(dict_b))
+    diffs = []
+    if set(fa) != set(fb):
+        diffs.append(
+            f"leaf sets differ: {sorted(set(fa) ^ set(fb))[:4]}"
+        )
+        return False, diffs
+    for k in sorted(fa):
+        a, b = fa[k], fb[k]
+        if isinstance(a, dict) or isinstance(b, dict):
+            if a != b:
+                diffs.append(f"{k}: structure mismatch")
+            continue
+        a, b = np.asarray(a), np.asarray(b)
+        if a.dtype != b.dtype:
+            diffs.append(f"{k}: dtype {a.dtype} vs {b.dtype}")
+        elif not np.array_equal(a, b):
+            diffs.append(f"{k}: values differ")
+    return not diffs, diffs
+
+
+def _decode_ckpt(path: str):
+    """Format-sniffing decode of one checkpoint file to a raw
+    state_dict of host arrays (no template needed — the parity
+    comparison is over the on-disk truth itself)."""
+    from flax import serialization
+
+    from multidisttorch_tpu.train import ckpt_store
+
+    with open(path, "rb") as f:
+        blob = f.read()
+    if ckpt_store.is_manifest_blob(blob):
+        manifest = ckpt_store.load_manifest(blob)
+        store = ckpt_store.ChunkStore(ckpt_store.chunk_dir_for(path))
+        return ckpt_store.restore_arrays(manifest, store), "v2"
+    return serialization.msgpack_restore(blob), "v1"
+
+
+def _run_flavor(flavor: str, out_dir: str, fmt: str) -> list[str]:
+    """Train one flavor writing ``fmt`` checkpoints; returns the
+    checkpoint paths it produced."""
+    from multidisttorch_tpu.data.datasets import synthetic_mnist
+    from multidisttorch_tpu.hpo.driver import TrialConfig, run_hpo
+    from multidisttorch_tpu.parallel.mesh import setup_groups
+
+    train = synthetic_mnist(128, seed=0)
+    base = dict(
+        epochs=1, batch_size=32, hidden_dim=16, latent_dim=4,
+        log_interval=1000,
+    )
+    prev = os.environ.get("MDT_CKPT_FORMAT")
+    os.environ["MDT_CKPT_FORMAT"] = fmt
+    try:
+        if flavor == "classic":
+            run_hpo(
+                [TrialConfig(trial_id=0, **base)],
+                train,
+                num_groups=1,
+                out_dir=out_dir,
+                save_images=False,
+                verbose=False,
+            )
+            return [os.path.join(out_dir, "trial-0", "state.msgpack")]
+        if flavor == "stacked":
+            cfgs = [
+                TrialConfig(trial_id=i, seed=i, **base) for i in range(2)
+            ]
+            run_hpo(
+                cfgs,
+                train,
+                num_groups=1,
+                out_dir=out_dir,
+                save_images=False,
+                verbose=False,
+                stack_trials=True,
+            )
+            return [
+                os.path.join(out_dir, f"trial-{i}", "state.msgpack")
+                for i in range(2)
+            ]
+        if flavor == "zero":
+            run_hpo(
+                [TrialConfig(trial_id=0, zero_update=True, **base)],
+                train,
+                num_groups=1,
+                out_dir=out_dir,
+                save_images=False,
+                verbose=False,
+            )
+            return [os.path.join(out_dir, "trial-0", "state.msgpack")]
+        if flavor == "pipelined":
+            from multidisttorch_tpu.hpo.pipeline_run import (
+                run_pipeline_trial,
+            )
+
+            groups = setup_groups(2)
+            cfg = TrialConfig(
+                trial_id=0,
+                pipeline_stages=2,
+                grad_accum=2,
+                **base,
+            )
+            run_pipeline_trial(
+                cfg,
+                train,
+                stage_meshes=groups,
+                out_dir=out_dir,
+                verbose=False,
+            )
+            return [
+                os.path.join(out_dir, "trial-0", f"stage{s}.msgpack")
+                for s in range(2)
+            ]
+        raise ValueError(flavor)
+    finally:
+        if prev is None:
+            os.environ.pop("MDT_CKPT_FORMAT", None)
+        else:
+            os.environ["MDT_CKPT_FORMAT"] = prev
+
+
+def run_parity_phase(work_dir: str) -> dict:
+    """v1↔v2 bitwise restore parity across every trial flavor."""
+    flavors = ("classic", "stacked", "zero", "pipelined")
+    out: dict = {"flavors": {}, "ok": True}
+    for flavor in flavors:
+        d1 = os.path.join(work_dir, f"parity_{flavor}_v1")
+        d2 = os.path.join(work_dir, f"parity_{flavor}_v2")
+        for d in (d1, d2):
+            shutil.rmtree(d, ignore_errors=True)
+        paths1 = _run_flavor(flavor, d1, "v1")
+        paths2 = _run_flavor(flavor, d2, "v2")
+        checks = []
+        for p1, p2 in zip(paths1, paths2):
+            sd1, f1 = _decode_ckpt(p1)
+            sd2, f2 = _decode_ckpt(p2)
+            eq, diffs = _bitwise_equal(sd1, sd2)
+            checks.append(
+                {
+                    "v1": p1,
+                    "v2": p2,
+                    "formats": [f1, f2],
+                    "bit_identical": eq,
+                    "diffs": diffs[:4],
+                }
+            )
+        fl_ok = bool(checks) and all(
+            c["bit_identical"] and c["formats"] == ["v1", "v2"]
+            for c in checks
+        )
+        # The manifest's layout record: the ZeRO flavor's sharded
+        # moments must be NAMED in the on-disk format (the
+        # sharded-native save skipped the gather, so the layout is
+        # real, not advisory fiction).
+        layout_recorded = None
+        if flavor == "zero":
+            from multidisttorch_tpu.train import ckpt_store
+
+            m = ckpt_store.read_manifest_file(paths2[0])
+            layout_recorded = bool(
+                m is not None
+                and any(
+                    "sharding" in leaf
+                    and "data" in str(leaf.get("sharding"))
+                    for leaf in m["leaves"]
+                    if leaf["key"].startswith("opt_state")
+                )
+            )
+            fl_ok = fl_ok and layout_recorded
+        out["flavors"][flavor] = {
+            "checks": checks,
+            "ok": fl_ok,
+            **(
+                {"zero_layout_recorded": layout_recorded}
+                if layout_recorded is not None
+                else {}
+            ),
+        }
+        out["ok"] = out["ok"] and fl_ok
+    return out
+
+
+def run_delta_phase(work_dir: str, *, epochs: int = 4) -> dict:
+    """Multi-epoch incremental-save drill: a head-only fine-tune (only
+    ``fc21``/``fc22`` — the latent heads — receive gradients; frozen
+    leaves and their Adam moments stay bitwise stable) checkpointed
+    every epoch under v2. The full-Adam contrast run (every leaf
+    changes every epoch) is recorded, not gated."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from multidisttorch_tpu.models.vae import VAE
+    from multidisttorch_tpu.ops.losses import elbo_loss_sum
+    from multidisttorch_tpu.train import checkpoint as ck
+    from multidisttorch_tpu.train.steps import build_train_state
+
+    model = VAE(hidden_dim=64, latent_dim=8)
+    rng = jax.random.key(0)
+    data = jax.random.uniform(jax.random.key(1), (20, 32, 784))
+
+    def make_step(train_keys: Optional[tuple]):
+        tx = optax.adam(1e-3)
+
+        @jax.jit
+        def step(state, batch, key):
+            def loss_fn(params):
+                recon, mu, logvar = model.apply(
+                    {"params": params}, batch, rngs={"reparam": key}
+                )
+                return elbo_loss_sum(recon, batch, mu, logvar)
+
+            grads = jax.grad(loss_fn)(state.params)
+            if train_keys is not None:
+                # Head-only fine-tune: zero the frozen subtrees'
+                # grads — Adam with zero grad and zero moments is a
+                # bitwise no-op on those leaves.
+                grads = {
+                    k: (
+                        v
+                        if k in train_keys
+                        else jax.tree.map(jnp.zeros_like, v)
+                    )
+                    for k, v in dict(grads).items()
+                }
+            updates, opt_state = tx.update(
+                grads, state.opt_state, state.params
+            )
+            return state.replace(
+                params=optax.apply_updates(state.params, updates),
+                opt_state=opt_state,
+                step=state.step + 1,
+            )
+
+        return step
+
+    def run_cadence(label: str, train_keys: Optional[tuple]) -> dict:
+        d = os.path.join(work_dir, f"delta_{label}")
+        shutil.rmtree(d, ignore_errors=True)
+        path = os.path.join(d, "state.msgpack")
+        state = build_train_state(model, optax.adam(1e-3), rng)
+        step = make_step(train_keys)
+        saves = []
+        for epoch in range(1, epochs + 1):
+            for i in range(5):
+                state = step(
+                    state,
+                    data[(epoch * 5 + i) % len(data)],
+                    jax.random.fold_in(rng, epoch * 5 + i),
+                )
+            stats: dict = {}
+            ck.save_state(
+                jax.device_get(state),
+                path,
+                metadata={"step": int(state.step), "epoch": epoch},
+                keep_last=2,
+                format="v2",
+                chunk_bytes=64 * 1024,
+                stats_out=stats,
+            )
+            saves.append(stats)
+        later = saves[1:]
+        ratios = [s["new_bytes"] / s["total_bytes"] for s in later]
+        return {
+            "saves": saves,
+            "model_bytes": saves[0]["total_bytes"],
+            "delta_ratio_mean": round(float(np.mean(ratios)), 4),
+            "delta_ratio_max": round(float(np.max(ratios)), 4),
+        }
+
+    finetune = run_cadence("finetune", ("fc21", "fc22"))
+    full = run_cadence("full", None)
+    return {
+        "epochs": epochs,
+        "finetune": finetune,
+        "full_adam_contrast": full,
+        "ok": finetune["delta_ratio_mean"] < 0.5,
+    }
+
+
+def _fill_pool(svc, client, *, base: dict, timeout_s: float = 120.0):
+    """Two distinct-bucket best-effort whales placed, each with a
+    durable checkpoint (movable) — the drain drills' fixture."""
+    subs = [
+        client.submit({**base, "epochs": 20, "hidden_dim": 16}),
+        client.submit({**base, "epochs": 20, "hidden_dim": 24}),
+    ]
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        svc.tick()
+        if len(svc.active) == 2 and all(
+            bool(ap.run.result.checkpoint) for ap in svc.active.values()
+        ):
+            return subs
+    raise TimeoutError("drain drill fixture never reached durable ckpts")
+
+
+def _wait_inflight(svc, *, timeout_s: float = 60.0):
+    """Tick until some active placement has a checkpoint write IN
+    FLIGHT (the persist delay guarantees the window is wide)."""
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        svc.tick()
+        for ap in svc.active.values():
+            if not ap.run._ckpt_idle():
+                return ap
+    raise TimeoutError("no checkpoint write observed in flight")
+
+
+def run_drain_primitive_phase(
+    work_dir: str, *, persist_delay_s: float = 0.3
+) -> dict:
+    """The drain primitive measured in both modes against an in-flight
+    write: drain-to-slices-freed wall, snapshot vs legacy join."""
+    from multidisttorch_tpu.hpo.supervision import RetryPolicy
+    from multidisttorch_tpu.service.runtime import SweepService
+
+    base = dict(batch_size=32, latent_dim=4, log_interval=1000)
+    arms = {}
+    prev_delay = os.environ.get(PERSIST_DELAY_ENV)
+    os.environ[PERSIST_DELAY_ENV] = str(persist_delay_s)
+    try:
+        for label, snapshot_drain, fmt in (
+            ("snapshot_v2", True, "v2"),
+            ("join_v1", False, "v1"),
+        ):
+            d = os.path.join(work_dir, f"drain_{label}")
+            shutil.rmtree(d, ignore_errors=True)
+            os.makedirs(d, exist_ok=True)
+            client = squeue.SweepClient(d, tenant="drill")
+            svc = SweepService(
+                d,
+                n_slices=2,
+                max_lanes=1,
+                data_rows=128,
+                defrag_enabled=False,
+                snapshot_drain=snapshot_drain,
+                ckpt_format=fmt,
+                retry=RetryPolicy(max_retries=2),
+            )
+            try:
+                _fill_pool(svc, client, base=base)
+                ap = _wait_inflight(svc)
+                free_before = svc.pool.free_total
+                t0 = time.perf_counter()
+                svc._checkpoint_drain(ap, reason="bench drain drill")
+                freed_s = time.perf_counter() - t0
+                freed_ok = svc.pool.free_total == free_before + ap.size
+                # Land everything before tearing the service down.
+                t1 = time.perf_counter()
+                while svc._pending_persists and (
+                    time.perf_counter() - t1 < 30
+                ):
+                    svc.tick()
+                persist_s = (
+                    time.perf_counter() - t0
+                    if snapshot_drain
+                    else freed_s
+                )
+                svc._drain(reason="drill end")
+                books = svc.books()
+            finally:
+                svc.store.shutdown()
+            arms[label] = {
+                "snapshot_drain": snapshot_drain,
+                "ckpt_format": fmt,
+                "drain_to_slices_freed_s": round(freed_s, 4),
+                "drain_to_persist_s": round(persist_s, 4),
+                "slices_freed": freed_ok,
+                "checkpoint_books": books.get("checkpoint"),
+            }
+    finally:
+        if prev_delay is None:
+            os.environ.pop(PERSIST_DELAY_ENV, None)
+        else:
+            os.environ[PERSIST_DELAY_ENV] = prev_delay
+    snap = arms["snapshot_v2"]["drain_to_slices_freed_s"]
+    join = arms["join_v1"]["drain_to_slices_freed_s"]
+    return {
+        "persist_delay_s": persist_delay_s,
+        "arms": arms,
+        "snapshot_faster": snap < join,
+        "snapshot_unblocked": snap < persist_delay_s / 2,
+        "speedup": round(join / snap, 1) if snap > 0 else None,
+        "ok": bool(
+            arms["snapshot_v2"]["slices_freed"]
+            and arms["join_v1"]["slices_freed"]
+            and snap < join
+            and snap < persist_delay_s / 2
+        ),
+    }
+
+
+def run_deadline_phase(
+    work_dir: str, *, persist_delay_s: float = 0.25
+) -> dict:
+    """End-to-end snapshot-drain deadline drill: the whale preempts
+    both best-effort lanes and places without waiting for their
+    persists; the ledger stays honest (``preempted`` only after the
+    persist lands — checked LIVE mid-drill); victims resume from the
+    RAM snapshot (same process) and complete."""
+    from multidisttorch_tpu.hpo.supervision import RetryPolicy
+    from multidisttorch_tpu.service.runtime import SweepService
+    from multidisttorch_tpu.service.scheduler import PreemptionPolicy
+    from multidisttorch_tpu.train import checkpoint as ck
+
+    from multidisttorch_tpu import telemetry
+
+    d = os.path.join(work_dir, "deadline")
+    shutil.rmtree(d, ignore_errors=True)
+    os.makedirs(d, exist_ok=True)
+    # Telemetry into the service dir: the banked drill self-documents —
+    # `sweep_trace` renders the snapshot/persist split inside the
+    # victims' attempt spans from these events.
+    own_telemetry = not telemetry.enabled()
+    if own_telemetry:
+        telemetry.configure(os.path.join(d, "telemetry"))
+    base = dict(batch_size=32, latent_dim=4, log_interval=1000)
+    client = squeue.SweepClient(d, tenant="drill")
+    policy = PreemptionPolicy(
+        max_preemptions_per_trial=1,
+        trial_cooldown_s=5.0,
+        global_cooldown_s=0.05,
+    )
+    svc = SweepService(
+        d,
+        n_slices=2,
+        max_lanes=1,
+        data_rows=128,
+        defrag_enabled=False,
+        preempt=policy,
+        snapshot_drain=True,
+        ckpt_format="v2",
+        retry=RetryPolicy(max_retries=2),
+    )
+    ram0 = ck.ckpt_counters()["restores_ram"]
+    prev_delay = os.environ.get(PERSIST_DELAY_ENV)
+    os.environ[PERSIST_DELAY_ENV] = str(persist_delay_s)
+    honesty = {
+        "observed_pending": False,
+        "preempted_before_persist": 0,
+        "slices_free_while_persisting": False,
+    }
+    try:
+        subs = _fill_pool(svc, client, base=base)
+        deadline_s = 120.0
+        big = client.submit(
+            {**base, "epochs": 1, "hidden_dim": 40, "seed": 9},
+            size=2,
+            deadline_s=deadline_s,
+        )
+        submit_ts = time.time()
+        placed_ts = None
+        while time.time() - submit_ts < 150:
+            svc.tick()
+            whale_live = any(
+                next(iter(ap.entries.values())).sub_id == big
+                for ap in svc.active.values()
+            )
+            if svc._pending_persists:
+                honesty["observed_pending"] = True
+                if svc.pool.free_total > 0 or whale_live:
+                    # The snapshot drain's point: resources moved ON
+                    # while a victim's persist was still in flight.
+                    honesty["slices_free_while_persisting"] = True
+                # LIVE honesty check: while a victim's persist is in
+                # flight, its preempted record must NOT be in the
+                # ledger yet.
+                pend_tids = {
+                    p.entry.trial_id for p in svc._pending_persists
+                }
+                try:
+                    with open(svc.ledger.path) as f:
+                        for line in f:
+                            try:
+                                rec = json.loads(line)
+                            except ValueError:
+                                continue
+                            if (
+                                rec.get("status") == "preempted"
+                                and rec.get("trial_id") in pend_tids
+                            ):
+                                honesty["preempted_before_persist"] += 1
+                except OSError:
+                    pass
+            if placed_ts is None and whale_live:
+                placed_ts = time.time()
+            if svc.settled.get(big):
+                break
+        big_status = svc.settled.get(big)
+        big_settle_s = round(time.time() - submit_ts, 3)
+        t0 = time.time()
+        while len(svc.settled) < 3 and time.time() - t0 < 600:
+            svc.tick()
+        svc._drain(reason="drill end")
+        books = svc.books()
+    finally:
+        if prev_delay is None:
+            os.environ.pop(PERSIST_DELAY_ENV, None)
+        else:
+            os.environ[PERSIST_DELAY_ENV] = prev_delay
+        svc.store.shutdown()
+        if own_telemetry:
+            telemetry.disable()
+    # The offline trace must show the drain split: every victim's tree
+    # carries a ckpt_persist SPAN (drain → durable) with real width.
+    from multidisttorch_tpu.telemetry import trace as ttrace
+
+    traces = ttrace.build_submission_traces(d)
+    persist_spans = sum(
+        1
+        for sid in subs
+        for s in (traces.get(sid) or {"spans": []})["spans"]
+        if s["name"] == "ckpt_persist"
+        and s["kind"] == "span"
+        and s["end"] is not None
+        and s["end"] - s["start"] > 0.01
+    )
+    ram_restores = ck.ckpt_counters()["restores_ram"] - ram0
+    ck_books = books.get("checkpoint") or {}
+    preempted_recs = 0
+    try:
+        with open(svc.ledger.path) as f:
+            preempted_recs = sum(
+                1 for line in f if '"preempted"' in line
+            )
+    except OSError:
+        pass
+    all_completed = len(svc.settled) == 3 and all(
+        s == "completed" for s in svc.settled.values()
+    )
+    return {
+        "persist_delay_s": persist_delay_s,
+        "deadline_submission": big,
+        "deadline_s": deadline_s,
+        "deadline_status": big_status,
+        "settle_latency_s": big_settle_s,
+        "whale_placed_after_s": (
+            round(placed_ts - submit_ts, 3) if placed_ts else None
+        ),
+        "honesty": honesty,
+        "preempted_records": preempted_recs,
+        "ram_restores": ram_restores,
+        "victims": subs,
+        "all_completed": all_completed,
+        "trace_persist_spans": persist_spans,
+        "checkpoint_books": ck_books,
+        "ok": bool(
+            big_status == "completed"
+            and big_settle_s < deadline_s
+            and honesty["observed_pending"]
+            and honesty["preempted_before_persist"] == 0
+            and preempted_recs >= 2
+            and ram_restores >= 1
+            and persist_spans >= 2
+            and all_completed
+        ),
+    }
+
+
+def run_ckpt_bench(work_dir: str) -> dict:
+    """The full ``bench.py --ckpt`` suite."""
+    from multidisttorch_tpu.train.checkpoint import reset_ckpt_counters
+
+    os.makedirs(work_dir, exist_ok=True)
+    reset_ckpt_counters()
+    parity = run_parity_phase(work_dir)
+    delta = run_delta_phase(work_dir)
+    primitive = run_drain_primitive_phase(work_dir)
+    deadline = run_deadline_phase(work_dir)
+    return {
+        "kind": "ckpt_data_plane",
+        "parity": parity,
+        "delta": delta,
+        "drain_primitive": primitive,
+        "deadline_drill": deadline,
+        "gates": {
+            "restore_parity_all_flavors": parity["ok"],
+            "delta_ratio_below_half": delta["ok"],
+            "snapshot_drain_faster_than_persist": primitive["ok"],
+            "deadline_drill": deadline["ok"],
+        },
+        "ok": bool(
+            parity["ok"]
+            and delta["ok"]
+            and primitive["ok"]
+            and deadline["ok"]
+        ),
+    }

@@ -20,10 +20,18 @@ to a preemption restart).
 Planner contract (tests/test_service.py enforces all three):
 
 - every move's victim is MOVABLE — a placement whose checkpoint state
-  is flushed to disk (or that has no progress to lose). A trial with
-  an unflushed checkpoint is NEVER migrated: migration restores from
-  the last durable checkpoint, and moving a trial whose newest work
-  exists only in an in-flight write would silently discard it.
+  is flushed to disk (or that has no progress to lose). Under the
+  legacy join-drain a trial with an unflushed checkpoint is NEVER
+  migrated: migration restores from the last durable checkpoint, and
+  moving a trial whose newest work exists only in an in-flight write
+  would silently discard it. Under the snapshot-fast drain
+  (docs/RESILIENCE.md "Snapshot-fast drain") that in-flight write is
+  ADOPTED instead — it lands on the victim's background writer
+  before the victim's `preempted` record, a same-process re-place
+  prefers the (newer) RAM snapshot, and a stale late persist can
+  never replace a successor's newer manifest (the save path's
+  step guard), so migration still never rolls back past it;
+  eligibility widens without weakening the rule.
 - relocation targets lie wholly OUTSIDE the window being cleared and
   fit in today's free runs — the plan is executable without a second
   defrag.

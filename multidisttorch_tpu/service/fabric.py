@@ -519,9 +519,18 @@ class FabricReplica:
 
     def _adopt(self, shard: int, fence: ShardFence) -> None:
         from multidisttorch_tpu.service.runtime import SweepService
+        from multidisttorch_tpu.train.checkpoint import snapshot_cache
 
         d = shard_dir(self.service_dir, shard)
         os.makedirs(d, exist_ok=True)
+        # RAM checkpoint snapshots are valid only under CONTINUOUS
+        # ownership of their paths: if this process served the shard
+        # before, lost the lease, and another replica wrote newer
+        # checkpoints, our cached snapshots are stale — restoring one
+        # would resurrect old weights over the adopter-era disk state.
+        # Adoption re-homing therefore always reads the durable v2
+        # manifests (scan-back / restore agreement), never our RAM.
+        snapshot_cache().drop_under(d)
         t0 = time.perf_counter()
         # fence_epoch stamps every journal/ledger record this
         # incarnation writes — the submission traces' evidence that a
@@ -608,6 +617,22 @@ class FabricReplica:
                 except Exception:  # noqa: BLE001
                     pass
         svc.active.clear()
+        # Snapshot-drained victims' background persists land in the
+        # shared shard dir (they can only HELP the adopter's scan-back)
+        # — but their ledger bookkeeping must NOT run: the fence is
+        # lost, and the fenced ledger would reject the stale append
+        # anyway. Join the writes, drop the bookkeeping.
+        for pend in list(svc._pending_persists):
+            try:
+                pend.ap.run._join_ckpt()
+            except Exception:  # noqa: BLE001
+                pass
+        svc._pending_persists.clear()
+        # Our RAM snapshots of this shard's trials die with the lease
+        # (the adopter's disk is the truth from here on).
+        from multidisttorch_tpu.train.checkpoint import snapshot_cache
+
+        snapshot_cache().drop_under(shard_dir(self.service_dir, shard))
         self._shutdown_service(svc)
 
     def _renew_leases(self, now: float) -> None:

@@ -244,16 +244,21 @@ class _Active:
     def free_blocks(self) -> list:
         return list(self.blocks) if self.blocks else [(self.start, self.size)]
 
-    def movable(self) -> bool:
+    def movable(self, snapshot_drain: bool = False) -> bool:
         """Defrag victim eligibility, decided at PLAN time: single
         runs only (stacked lanes checkpoint at retirement, so a moved
         bucket would lose every live lane's progress), and never with
-        an UNFLUSHED checkpoint — a write still in flight (or progress
-        beyond the last landed checkpoint... which migration would
-        roll back to) must finish before the trial may move. Precisely:
-        movable iff no checkpoint write is in flight AND (a durable
-        checkpoint exists OR the trial has made no optimizer step —
-        nothing to lose)."""
+        an UNFLUSHED checkpoint the drain cannot account for.
+        Precisely: movable iff (a durable checkpoint exists OR the
+        trial has made no optimizer step — nothing to lose) AND, in
+        the legacy join-drain mode, no checkpoint write is in flight.
+        Under the snapshot-fast drain an in-flight write is ADOPTED
+        instead of blocking eligibility — it lands in the background
+        before the victim's ``preempted`` record, the same-process
+        re-place prefers the newer RAM snapshot, and the save path's
+        step guard keeps a stale late persist from replacing a
+        successor's newer manifest — migration still never rolls back
+        past it."""
         if self.stacked:
             return False
         if self.blocks is not None and len(self.blocks) > 1:
@@ -263,10 +268,36 @@ class _Active:
             return False
         run = self.run
         t = getattr(run, "_ckpt_thread", None)
-        if t is not None and t.is_alive():
+        in_flight = t is not None and t.is_alive()
+        if in_flight and not snapshot_drain:
             return False  # unflushed checkpoint write in flight
-        has_ckpt = bool(run.result.checkpoint)
+        has_ckpt = bool(run.result.checkpoint) or in_flight
         return has_ckpt or int(getattr(run, "_step_no", 0)) == 0
+
+
+@dataclass
+class _PendingPersist:
+    """A snapshot-drained victim whose checkpoint persistence is still
+    landing in the background (docs/RESILIENCE.md "Snapshot-fast
+    drain"). The placement's slices are already free and the entry is
+    already requeued (a defrag victim must claim its pinned relocation
+    target on the NEXT pass — deferring the requeue would let another
+    tenant steal it and waste the whole window); only the ledger
+    ``preempted`` record waits for the persist — the honesty rule: a
+    crash before the persist leaves an OPEN attempt whose scan-back
+    restores the previous durable step, exactly as if the drain had
+    never happened. ``chash``/``attempt`` are captured at drain time:
+    the victim may re-place — even settle — before its old attempt's
+    record becomes writable."""
+
+    ap: _Active
+    entry: object  # PendingTrial
+    reason: str
+    progress: dict
+    chash: str
+    attempt: int
+    t0: float
+    snapshot_s: float
 
 
 class SweepService:
@@ -304,6 +335,8 @@ class SweepService:
         retry: Optional[RetryPolicy] = None,
         save_checkpoints: bool = True,
         ckpt_keep_last: int = 2,
+        ckpt_format: Optional[str] = None,
+        snapshot_drain: Optional[bool] = None,
         verbose: bool = False,
         precompile: bool = False,
         idle_sleep_s: float = 0.02,
@@ -378,6 +411,34 @@ class SweepService:
         self.retry = retry
         self.save_checkpoints = bool(save_checkpoints)
         self.ckpt_keep_last = int(ckpt_keep_last)
+        # Checkpoint data plane (docs/RESILIENCE.md "Checkpoint format
+        # v2"): the format every placement writes, and the drain mode —
+        # snapshot-fast (default: a preemption completes at the
+        # device→host snapshot, persistence lands on the victim's
+        # background writer, the freed slices place the starved trial
+        # immediately) vs the legacy join-drain (MDT_SNAPSHOT_DRAIN=0,
+        # the bench's v1 comparison arm).
+        from multidisttorch_tpu.train.checkpoint import default_format
+
+        self.ckpt_format = (
+            ckpt_format if ckpt_format is not None else default_format()
+        )
+        self.snapshot_drain = bool(
+            snapshot_drain
+            if snapshot_drain is not None
+            else os.environ.get("MDT_SNAPSHOT_DRAIN", "1") != "0"
+        )
+        self._pending_persists: list[_PendingPersist] = []
+        # Counter baseline for this INSTANCE's books: the checkpoint
+        # counters are process-wide, and a fabric replica runs one
+        # SweepService per owned shard in one process — each shard's
+        # books must report its own era, not the process totals.
+        # (Two CONCURRENTLY-live shard services still share the
+        # counters; their books are deltas from their own adoption,
+        # the honest per-incarnation view the fold can sum.)
+        from multidisttorch_tpu.train.checkpoint import ckpt_counters
+
+        self._ckpt_counter_base = ckpt_counters()
         self.verbose = bool(verbose)
         self.precompile = bool(precompile)
         self.idle_sleep_s = float(idle_sleep_s)
@@ -434,6 +495,13 @@ class SweepService:
 
         self.queue_wait = Histogram(LATENCY_BUCKETS)
         self.placement_latency = Histogram(LATENCY_BUCKETS)
+        # Drain-phase books: snapshot = drain call → slices freed;
+        # persist = drain call → the victim's checkpoint durably on
+        # disk (the ledger-record moment). The gap between the two is
+        # the latency the snapshot-fast drain takes OFF the starved
+        # trial's critical path.
+        self.drain_snapshot = Histogram(LATENCY_BUCKETS)
+        self.drain_persist = Histogram(LATENCY_BUCKETS)
 
         self._recover()
         if self.precompile:
@@ -912,6 +980,8 @@ class SweepService:
                 verbose=self.verbose,
                 resume="scan" if e.resume_scan else False,
                 ckpt_keep_last=self.ckpt_keep_last,
+                ckpt_format=self.ckpt_format,
+                ram_restore=self.snapshot_drain,
                 attempt=self.attempts[e.trial_id],
             )
         except Exception as exc:  # noqa: BLE001 — setup isolation
@@ -1052,6 +1122,7 @@ class SweepService:
                     chashes=self.chashes,
                     infra_fails=self.infra_fails,
                     datasets=datasets,
+                    ckpt_format=self.ckpt_format,
                 )
             else:
                 e = members[0]
@@ -1074,6 +1145,8 @@ class SweepService:
                     verbose=self.verbose,
                     resume="scan" if e.resume_scan else False,
                     ckpt_keep_last=self.ckpt_keep_last,
+                    ckpt_format=self.ckpt_format,
+                    ram_restore=self.snapshot_drain,
                     attempt=self.attempts[e.trial_id],
                 )
         except Exception as exc:  # noqa: BLE001 — setup isolation
@@ -1308,6 +1381,14 @@ class SweepService:
 
     def _completed(self, ap: _Active) -> None:
         self._retire(ap)
+        # A finished trial never restores again: free its RAM snapshot
+        # now instead of waiting for LRU churn.
+        from multidisttorch_tpu.train.checkpoint import snapshot_cache
+
+        for attr in ("_ckpt_path", "_ckpt_paths"):
+            got = getattr(ap.run, attr, None)
+            for p in got if isinstance(got, list) else ([got] if got else []):
+                snapshot_cache().drop(p)
         if ap.stacked:
             results = ap.run.results
             unfinished = {tid for tid, _ in ap.run.unfinished()}
@@ -1460,7 +1541,7 @@ class SweepService:
                     placement_id=pid,
                     start=bstart,
                     size=bsize,
-                    movable=ap.movable(),
+                    movable=ap.movable(self.snapshot_drain),
                 )
                 for pid, ap in self.active.items()
                 for bstart, bsize in ap.free_blocks()
@@ -1500,13 +1581,21 @@ class SweepService:
             ap = self.active.get(pid)
             if ap is None or ap.stacked:
                 continue  # raced a completion; window may open anyway
-            entry = self._checkpoint_drain(ap, reason="defrag migration")
             # The victim re-enters the queue FRONT, pinned to the
             # planner's relocation target (outside the window); the
             # next scheduling pass serves it first, so it claims its
             # pin before the starved trial claims the opened window.
             # No pre-reservation: the pool must show the window free
             # or the starved trial's own allocation would fail.
+            # (Snapshot-fast drain: the requeue happens inside
+            # _checkpoint_drain — only the ledger record waits for
+            # the victim's background persist.)
+            entry = self._checkpoint_drain(
+                ap,
+                reason="defrag migration",
+                pinned_start=new_start,
+                front=True,
+            )
             _emit(
                 "defrag_move",
                 trial_id=entry.trial_id,
@@ -1522,12 +1611,6 @@ class SweepService:
                 src_group=ap.start,
                 dst_group=new_start,
                 reason="defrag",
-            )
-            self._requeue(
-                entry,
-                reason="defrag migration",
-                pinned_start=new_start,
-                front=True,
             )
             moved += ap.size
         self._defrag_count += 1
@@ -1548,34 +1631,183 @@ class SweepService:
 
     # -- deadline preemption ------------------------------------------
 
-    def _checkpoint_drain(self, ap: _Active, *, reason: str) -> PendingTrial:
-        """The first-class preemption primitive (defrag's move and the
-        deadline eviction share it): close the victim's generator at
-        its current yield point, land any in-flight checkpoint write,
-        ledger the attempt ``preempted``, and retire the placement —
-        the caller decides where (and whether pinned) the entry
-        requeues. The victim resumes from its last durable epoch
-        boundary via the scan-back restore (PR 5's machinery)."""
+    def _checkpoint_drain(
+        self,
+        ap: _Active,
+        *,
+        reason: str,
+        pinned_start: Optional[int] = None,
+        front: bool = False,
+    ) -> PendingTrial:
+        """The first-class preemption primitive (defrag's move, the
+        deadline eviction and the graceful drain share it), in two
+        phases (docs/RESILIENCE.md "Snapshot-fast drain"):
+
+        **Snapshot** (synchronous): close the victim's generator at its
+        current yield point and retire the placement — the slices free
+        HERE, so the starved trial places without waiting for a single
+        fsync. The victim's freshest epoch-boundary state is already in
+        the RAM snapshot cache (written at the device→host fetch), so a
+        same-process re-place restores warm.
+
+        **Persist** (background): any in-flight checkpoint write keeps
+        running on the victim's own writer thread; the drain only
+        registers it as a :class:`_PendingPersist`. The entry requeues
+        immediately (pinned/front as the caller planned — a defrag
+        victim must claim its relocation target on the next pass); the
+        ledger ``preempted`` record lands when the persist does
+        (:meth:`_poll_persists`) — ``preempted`` is recorded only after
+        the durable bytes exist, so crash-recovery semantics are
+        unchanged: a SIGKILL mid-persist leaves an OPEN attempt whose
+        scan-back restores the previous durable step.
+
+        ``snapshot_drain=False`` (the bench's v1 comparison arm) keeps
+        the legacy behavior: join the write inline, ledger, requeue —
+        the full-persist drain the artifact measures against."""
         entry = next(iter(ap.entries.values()))
         tid = entry.trial_id
+        t0 = time.perf_counter()
         try:
             ap.gen.close()
         except Exception:  # noqa: BLE001 — teardown must go on
             pass
+        progress = self._attempt_progress(ap, tid)
+        if self.snapshot_drain:
+            self._retire(ap)
+            snap_s = time.perf_counter() - t0
+            self.drain_snapshot.observe(snap_s, exemplar=entry.sub_id)
+            _emit(
+                "ckpt_snapshot",
+                trial_id=tid,
+                sub_id=entry.sub_id,
+                tenant=entry.tenant,
+                wall_s=round(snap_s, 6),
+                drain=True,
+                reason=reason,
+                persist_in_flight=not ap.run._ckpt_idle(),
+            )
+            self._pending_persists.append(
+                _PendingPersist(
+                    ap=ap,
+                    entry=entry,
+                    reason=reason,
+                    progress=progress,
+                    chash=self.chashes.get(tid, ""),
+                    attempt=self.attempts.get(tid, 1),
+                    t0=t0,
+                    snapshot_s=snap_s,
+                )
+            )
+            self._requeue(
+                entry,
+                reason=reason,
+                pinned_start=pinned_start,
+                front=front,
+            )
+            return entry
+        # Legacy full-persist drain: everything on the caller's clock.
         try:
             ap.run._join_ckpt()
         except Exception:  # noqa: BLE001
             pass
+        self._retire(ap)
+        persist_s = time.perf_counter() - t0
+        self.drain_snapshot.observe(persist_s, exemplar=entry.sub_id)
+        self.drain_persist.observe(persist_s, exemplar=entry.sub_id)
+        _emit(
+            "ckpt_persist",
+            trial_id=tid,
+            sub_id=entry.sub_id,
+            tenant=entry.tenant,
+            wall_s=round(persist_s, 6),
+            drain=True,
+            mode="join",
+            reason=reason,
+        )
         self.ledger.attempt_end(
             tid,
             self.chashes[tid],
             self.attempts.get(tid, 1),
             "preempted",
             error=reason,
-            summary=self._attempt_progress(ap, tid),
+            summary=progress,
         )
-        self._retire(ap)
+        self._requeue(
+            entry,
+            reason=reason,
+            pinned_start=pinned_start,
+            front=front,
+        )
         return entry
+
+    def _poll_persists(self, now: float) -> bool:
+        """Land snapshot-drained victims' deferred bookkeeping once
+        their background persist finishes: the drain-persist book and
+        the honest ``preempted`` ledger record (the requeue already
+        happened at drain time). A FAILED persist still ends the
+        attempt — noted in the record; the durable checkpoint is
+        simply the previous one, which the scan-back restore (or the
+        RAM snapshot, same-process) recovers."""
+        if not self._pending_persists:
+            return False
+        progressed = False
+        for pend in list(self._pending_persists):
+            run = pend.ap.run
+            if not run._ckpt_idle():
+                continue
+            self._pending_persists.remove(pend)
+            progressed = True
+            err = getattr(run, "_ckpt_error", None)
+            entry = pend.entry
+            tid = entry.trial_id
+            persist_s = time.perf_counter() - pend.t0
+            self.drain_persist.observe(persist_s, exemplar=entry.sub_id)
+            _emit(
+                "ckpt_persist",
+                trial_id=tid,
+                sub_id=entry.sub_id,
+                tenant=entry.tenant,
+                wall_s=round(persist_s, 6),
+                snapshot_s=round(pend.snapshot_s, 6),
+                drain=True,
+                mode="background",
+                ok=err is None,
+                reason=pend.reason,
+            )
+            error = pend.reason
+            if err is not None:
+                error += (
+                    f"; persist failed: {type(err).__name__}: {err} "
+                    "(previous durable step remains restorable)"
+                )
+            if pend.chash:
+                # Attempt identity captured at drain time: the victim
+                # may already be running (even settled as) a LATER
+                # attempt — this record belongs to the drained one.
+                self.ledger.attempt_end(
+                    tid,
+                    pend.chash,
+                    pend.attempt,
+                    "preempted",
+                    error=error,
+                    summary=pend.progress,
+                )
+        return progressed
+
+    def _flush_persists(self) -> None:
+        """Drain-time barrier (SIGTERM / daemon exit): join every
+        pending background persist and land its bookkeeping — the
+        process is going away, so 'background' no longer exists. The
+        exit path's honesty contract (preempted only after the write)
+        is preserved because the join happens first. Joins the writer
+        THREAD directly, not ``_join_ckpt`` — that helper consumes
+        ``_ckpt_error`` on its way to raising, and the poll below must
+        still see a failed persist to note it in the record."""
+        for pend in list(self._pending_persists):
+            t = getattr(pend.ap.run, "_ckpt_thread", None)
+            if t is not None and t.is_alive():
+                t.join()
+        self._poll_persists(time.time())
 
     def _preemptible(self, ap: _Active, now: float) -> bool:
         """May this placement be EVICTED for a deadline right now?
@@ -1583,7 +1815,7 @@ class SweepService:
         deadline trial — EDF already ordered them), checkpoint-drained
         safely (``movable``: single, durable checkpoint or nothing to
         lose), and within the anti-thrash budget."""
-        if not ap.movable():
+        if not ap.movable(self.snapshot_drain):
             return False
         for tid, entry in ap.entries.items():
             if entry.deadline_ts is not None:
@@ -1675,6 +1907,11 @@ class SweepService:
                 ap = self.active.get(pid)
                 if ap is None or not self._preemptible(ap, now):
                     continue  # raced a completion/checkpoint start
+                # Victims rejoin the best-effort backlog (EDF keeps
+                # them behind every deadline) once their persist
+                # lands, and resume from their drained checkpoint —
+                # or the RAM snapshot, same-process — on their next
+                # placement.
                 entry = self._checkpoint_drain(
                     ap,
                     reason=(
@@ -1696,10 +1933,6 @@ class SweepService:
                     preempt_count=entry.preempt_count,
                     for_sub_id=starved.sub_id,
                 )
-                # Victims rejoin the best-effort backlog (EDF keeps
-                # them behind every deadline) and resume from their
-                # drained checkpoint on their next placement.
-                self._requeue(entry, reason="deadline preemption")
             self._preempt_events += 1
             self._preempt_targets.add(starved.sub_id)
             self.preempt.last_event_ts = now
@@ -1751,6 +1984,10 @@ class SweepService:
 
     def _drain(self, *, reason: str) -> None:
         _emit("service_drain", in_flight=len(self.active), reason=reason)
+        # Pending background persists first: the process is exiting, so
+        # their writes must land (and their preempted records with
+        # them) before the final books.
+        self._flush_persists()
         for pid in list(self.active):
             ap = self.active.pop(pid)
             try:
@@ -1797,6 +2034,35 @@ class SweepService:
         fold_tenant_goodput_into(
             self._tenant_fold, self._tenant_covered, recs
         )
+
+    def _ckpt_books(self) -> dict:
+        """The checkpoint data plane's service books: drain-phase
+        latency split (snapshot = slices-freed, persist = durable),
+        process-wide byte counters (written vs delta-reused), and the
+        snapshot-drain backlog."""
+        from multidisttorch_tpu.train.checkpoint import ckpt_counters
+
+        now = ckpt_counters()
+        c = {
+            k: now[k] - self._ckpt_counter_base.get(k, 0) for k in now
+        }
+        total = c["bytes_total"]
+        return {
+            "format": self.ckpt_format,
+            "snapshot_drain": self.snapshot_drain,
+            "pending_persists": len(self._pending_persists),
+            "drain_snapshot": self.drain_snapshot.stats(),
+            "drain_persist": self.drain_persist.stats(),
+            "saves": c["saves"],
+            "bytes_total": total,
+            "bytes_written": c["bytes_written"],
+            "bytes_reused": c["bytes_reused"],
+            "delta_ratio": (
+                round(c["bytes_written"] / total, 4) if total else None
+            ),
+            "restores": c["restores"],
+            "restores_ram": c["restores_ram"],
+        }
 
     def books(self) -> dict:
         self._advance_folds()
@@ -1857,6 +2123,7 @@ class SweepService:
                     "enabled": self.preempt.enabled,
                 },
             },
+            "checkpoint": self._ckpt_books(),
             "deadline": {
                 "hits": self._deadline_hits,
                 "misses": self._deadline_misses,
@@ -1915,16 +2182,22 @@ class SweepService:
         for p in placements:
             self._start_placement(p)
         progressed = self._step_actives()
+        # Snapshot-drained victims whose background persist landed:
+        # honest `preempted` records + requeues (the deferred half of
+        # _checkpoint_drain).
+        persisted = self._poll_persists(now)
         self._maybe_preempt(now)
         self._maybe_defrag(now)
         if now - self._last_books_ts >= self.books_every_s:
             self._last_books_ts = now
             self.write_books()
-        return bool(fresh or placements or progressed)
+        return bool(fresh or placements or progressed or persisted)
 
     def idle(self) -> bool:
-        """Nothing running, nothing schedulable, nothing in the spool."""
-        if self.active or self.sched.pending_count():
+        """Nothing running, nothing schedulable, nothing in the spool
+        — and no snapshot-drained victim still persisting (its honest
+        ``preempted`` ledger record hasn't landed yet)."""
+        if self.active or self.sched.pending_count() or self._pending_persists:
             return False
         d = squeue.intake_dir(self.service_dir)
         try:

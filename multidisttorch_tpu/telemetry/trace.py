@@ -237,6 +237,15 @@ _TRIAL_INSTANTS = (
     "pipeline_start",
     "pipeline_epoch",
 )
+# Trial-keyed events carrying a wall_s that render as SPANS (ending at
+# the event's timestamp) inside the covering attempt — the checkpoint
+# data plane's snapshot/persist split (docs/RESILIENCE.md): a drain's
+# trace shows exactly how much of the preemption sat on the victim's
+# critical path (snapshot) vs landed behind it (persist).
+_TRIAL_PHASES = (
+    "ckpt_snapshot",
+    "ckpt_persist",
+)
 # Kinds attached by submission id as instants on the root span.
 _SUB_INSTANTS = (
     "defrag_move",
@@ -590,6 +599,24 @@ def _attach_ledger(
             )
 
 
+_SPAN_RESERVED = {"name", "start", "end", "parent", "kind"}
+
+
+def _event_tags(data: dict, *, exclude: tuple = ()) -> dict:
+    """Event-data fields safe to pass as ``_span(**tags)``: scalars
+    only, and keys colliding with span fields remapped (a
+    ``preempt_victim``'s ``start`` is a SLICE index, not a timestamp —
+    unremapped it shadows the span's own start)."""
+    out = {}
+    for k, v in data.items():
+        if k in exclude or not isinstance(v, (str, int, float, bool)):
+            continue
+        if k in _SPAN_RESERVED:
+            k = f"ev_{k}"
+        out[k] = v
+    return out
+
+
 def _attempt_for(tr: dict, trial_id, ts: float) -> Optional[int]:
     """Index of the attempt span covering ``ts`` for this trial (open
     attempts cover everything after their start)."""
@@ -712,17 +739,14 @@ def _attach_events(
                         end=ts,
                         parent=0,
                         kind="instant",
-                        **{
-                            k: v
-                            for k, v in data.items()
-                            if k not in ("sub_id",)
-                            and isinstance(v, (str, int, float, bool))
-                        },
+                        **_event_tags(data, exclude=("sub_id",)),
                     ),
                 )
             continue
-        if kind in _TRIAL_INSTANTS:
+        if kind in _TRIAL_INSTANTS or kind in _TRIAL_PHASES:
             tid = ev.get("trial_id")
+            if tid is None:
+                tid = data.get("trial_id")  # 0 is a valid trial id
             if tid is None:
                 continue
             candidates = []
@@ -744,14 +768,28 @@ def _attach_events(
             if parent_idx is None:
                 p = _placement_for(tr, ts)
                 parent_idx = p["_idx"] if p is not None else 0
-            tags = {
-                k: v
-                for k, v in data.items()
-                if isinstance(v, (str, int, float, bool))
-            }
+            tags = _event_tags(data)
             name = kind
             if kind == "epoch" and ev.get("step") is not None:
                 name = f"epoch@step {ev.get('step')}"
+            if kind in _TRIAL_PHASES:
+                # Phase span: wall_s wide, ending at the emit instant
+                # (both events fire when their phase COMPLETES).
+                try:
+                    wall = max(0.0, float(data.get("wall_s") or 0.0))
+                except (TypeError, ValueError):
+                    wall = 0.0
+                _add_span(
+                    tr,
+                    _span(
+                        name,
+                        start=ts - wall,
+                        end=ts,
+                        parent=parent_idx,
+                        **tags,
+                    ),
+                )
+                continue
             _add_span(
                 tr,
                 _span(

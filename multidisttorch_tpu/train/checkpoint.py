@@ -36,15 +36,134 @@ import json
 import os
 import re
 import shutil
+import threading
+import time
 import zlib
+from collections import OrderedDict
 from typing import Any, Callable, Optional
 
 import jax
 from flax import serialization
 
 from multidisttorch_tpu.parallel.mesh import TrialMesh
+from multidisttorch_tpu.train import ckpt_store
 
 _VERSION_RE = re.compile(r"\.v(\d+)$")
+
+# The RAM-snapshot restore's sentinel "path": restore_latest_valid /
+# _restore_scan report it as the used candidate so books and logs can
+# tell a warm re-place from a disk read.
+RAM_SNAPSHOT = "<ram-snapshot>"
+
+
+def default_format() -> str:
+    """The checkpoint format new saves use: ``MDT_CKPT_FORMAT`` env
+    (``v1`` = legacy full-msgpack, ``v2`` = sharded-native chunked
+    manifests — the default). Restore always sniffs per file, so mixed
+    directories (a v1 history under a v2 primary) scan back fine."""
+    fmt = os.environ.get("MDT_CKPT_FORMAT", "v2")
+    return "v1" if fmt == "v1" else "v2"
+
+
+# Process-wide checkpoint data-plane counters (plain ints — always on;
+# the zero-cost-when-off telemetry contract governs Event OBJECTS, not
+# counter increments). The service books and bench read these.
+_CKPT_LOCK = threading.Lock()
+_CKPT_COUNTERS = {
+    "saves": 0,
+    "saves_v1": 0,
+    "bytes_total": 0,
+    "bytes_written": 0,
+    "bytes_reused": 0,
+    "chunks_written": 0,
+    "restores": 0,
+    "restores_ram": 0,
+}
+
+
+def ckpt_counters() -> dict:
+    with _CKPT_LOCK:
+        return dict(_CKPT_COUNTERS)
+
+
+def reset_ckpt_counters() -> None:
+    with _CKPT_LOCK:
+        for k in _CKPT_COUNTERS:
+            _CKPT_COUNTERS[k] = 0
+
+
+def _count(**kw) -> None:
+    with _CKPT_LOCK:
+        for k, v in kw.items():
+            _CKPT_COUNTERS[k] += v
+
+
+class _SnapshotCache:
+    """Process-wide RAM cache of the newest host-side checkpoint
+    snapshot per checkpoint path (the snapshot-fast drain's warm
+    restore source): a preempted trial re-placed in the SAME process
+    restores straight from RAM instead of re-reading chunks.
+
+    Entries are only ever written at the device→host fetch that also
+    feeds the durable write, so an entry is always at least as new as
+    the newest on-disk candidate for its path — within this process's
+    continuous ownership of the path. Ownership breaks (a fabric
+    replica losing/adopting a shard another process wrote to) must
+    ``drop_under`` the affected directory: a stale RAM snapshot would
+    otherwise resurrect old weights over the adopter's newer disk
+    state. Bounded LRU (``MDT_SNAPSHOT_CACHE``)."""
+
+    def __init__(self, max_entries: int = 8):
+        self._lock = threading.Lock()
+        self._max = max(1, int(max_entries))
+        self._entries: OrderedDict[str, tuple[Any, dict]] = OrderedDict()
+
+    def put(self, path: str, host_state: Any, meta: dict) -> None:
+        key = os.path.abspath(path)
+        with self._lock:
+            self._entries.pop(key, None)
+            self._entries[key] = (host_state, dict(meta))
+            while len(self._entries) > self._max:
+                self._entries.popitem(last=False)
+
+    def get(self, path: str) -> Optional[tuple[Any, dict]]:
+        key = os.path.abspath(path)
+        with self._lock:
+            got = self._entries.get(key)
+            if got is not None:
+                self._entries.move_to_end(key)
+            return got
+
+    def drop(self, path: str) -> None:
+        with self._lock:
+            self._entries.pop(os.path.abspath(path), None)
+
+    def drop_under(self, prefix: str) -> int:
+        """Invalidate every snapshot under a directory (fabric shard
+        ownership changes). Returns how many were dropped."""
+        pre = os.path.abspath(prefix).rstrip(os.sep) + os.sep
+        with self._lock:
+            dead = [k for k in self._entries if k.startswith(pre)]
+            for k in dead:
+                del self._entries[k]
+            return len(dead)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+_SNAPSHOTS = _SnapshotCache(
+    int(os.environ.get("MDT_SNAPSHOT_CACHE", "8"))
+)
+
+
+def snapshot_cache() -> _SnapshotCache:
+    return _SNAPSHOTS
 
 
 class CheckpointError(RuntimeError):
@@ -52,33 +171,13 @@ class CheckpointError(RuntimeError):
     otherwise)."""
 
 
-def _fsync_dir(path: str) -> None:
-    """Durably record a directory entry (the rename itself) — without
-    this, a power loss after ``os.replace`` can resurrect the old file.
-    Best-effort: some filesystems refuse O_RDONLY dir fsync."""
-    d = os.path.dirname(path) or "."
-    try:
-        fd = os.open(d, os.O_RDONLY)
-    except OSError:
-        return
-    try:
-        os.fsync(fd)
-    except OSError:
-        pass
-    finally:
-        os.close(fd)
-
-
-def _write_atomic(path: str, blob: bytes, *, fsync: bool) -> None:
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-        if fsync:
-            f.flush()
-            os.fsync(f.fileno())
-    os.replace(tmp, path)
-    if fsync:
-        _fsync_dir(path)
+# One durability-helper family for the whole checkpoint layer, owned
+# by the jax-free lower module (writer-unique tmp names — the
+# snapshot-fast drain makes same-path writer overlap legal): without
+# the dir fsync, a power loss after ``os.replace`` can resurrect the
+# old file through the new name.
+_fsync_dir = ckpt_store._fsync_dir
+_write_atomic = ckpt_store.write_atomic
 
 
 def _copy_replace(src: str, dst: str) -> None:
@@ -88,9 +187,7 @@ def _copy_replace(src: str, dst: str) -> None:
     its newest retained version with it, silently shrinking the
     scan-back depth from K to K-1. States here are small; pay the copy
     and keep the retention contract exact."""
-    tmp = dst + ".tmp"
-    if os.path.exists(tmp):
-        os.remove(tmp)
+    tmp = f"{dst}.{os.getpid()}.{threading.get_ident()}.tmp"
     shutil.copy2(src, tmp)
     os.replace(tmp, dst)
 
@@ -102,6 +199,10 @@ def save_state(
     metadata: Optional[dict] = None,
     keep_last: int = 1,
     fsync: bool = True,
+    format: Optional[str] = None,
+    layouts: Any = None,
+    chunk_bytes: Optional[int] = None,
+    stats_out: Optional[dict] = None,
 ) -> str:
     """Serialize a state pytree (host-side) to ``path`` (msgpack).
 
@@ -121,28 +222,76 @@ def save_state(
     :func:`restore_latest_valid` history to scan back through when the
     latest is torn or corrupted. ``fsync=False`` opts out of the
     durability syncs (benchmarks on throwaway dirs).
-    """
-    import time as _time
 
+    ``format`` picks the on-disk layout: ``"v1"`` is the legacy
+    full-msgpack blob; ``"v2"`` (the :func:`default_format` when the
+    caller passes None... which resolves to v1 here for direct callers'
+    byte-stability — the DRIVER paths opt into v2 explicitly) writes a
+    chunked manifest over a content-addressed store (``ckpt_store``):
+    unchanged chunks are referenced, not rewritten, and ``keep_last``
+    retains manifests (tiny) with chunks SHARED across versions under a
+    refcounting GC. ``layouts`` optionally records the live state's
+    shardings in the manifest; ``stats_out`` receives the save's
+    written/reused byte split.
+    """
     from multidisttorch_tpu.telemetry.events import get_bus
 
-    t0 = _time.perf_counter()
+    fmt = format if format is not None else "v1"
+    t0 = time.perf_counter()
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     _require_fully_addressable(state, "save_state")
     host_state = jax.device_get(state)
-    blob = serialization.to_bytes(host_state)
-    _write_atomic(path, blob, fsync=fsync)
-
-    meta = dict(metadata) if metadata is not None else {}
-    meta["_integrity"] = {"crc32": zlib.crc32(blob), "nbytes": len(blob)}
-    _write_atomic(
-        path + ".json",
-        json.dumps(meta, indent=2, default=str).encode(),
-        fsync=fsync,
+    # Deterministic test/bench seam: a bounded persist delay makes the
+    # snapshot-vs-persist drain split measurable on states whose real
+    # serialize+fsync cost is microseconds (docs/RESILIENCE.md).
+    delay = float(os.environ.get("MDT_CKPT_PERSIST_DELAY_S", "0") or 0)
+    if delay > 0:
+        time.sleep(delay)
+    if fmt == "v2":
+        stats = _save_state_v2(
+            host_state,
+            path,
+            metadata=metadata,
+            keep_last=keep_last,
+            fsync=fsync,
+            layouts=layouts,
+            chunk_bytes=chunk_bytes,
+        )
+    else:
+        blob = serialization.to_bytes(host_state)
+        _write_atomic(path, blob, fsync=fsync)
+        meta = dict(metadata) if metadata is not None else {}
+        meta["_integrity"] = {
+            "crc32": zlib.crc32(blob),
+            "nbytes": len(blob),
+        }
+        _write_atomic(
+            path + ".json",
+            json.dumps(meta, indent=2, default=str).encode(),
+            fsync=fsync,
+        )
+        if keep_last > 1:
+            _retain_version(path, meta, keep_last)
+        stats = {
+            "format": "v1",
+            "total_bytes": len(blob),
+            "new_bytes": len(blob),
+            "reused_bytes": 0,
+            "chunks": 0,
+            "chunks_written": 0,
+            "delta_ratio": 1.0,
+        }
+        _count(saves_v1=1)
+    _count(
+        saves=1,
+        bytes_total=stats["total_bytes"],
+        bytes_written=stats["new_bytes"],
+        bytes_reused=stats["reused_bytes"],
+        chunks_written=stats["chunks_written"],
     )
-
-    if keep_last > 1:
-        _retain_version(path, meta, keep_last)
+    if stats_out is not None:
+        stats_out.update(stats)
+    meta_src = metadata if metadata is not None else {}
     bus = get_bus()
     if bus is not None:
         # Emitted once the whole save — state, CRC sidecar, retention —
@@ -152,13 +301,100 @@ def save_state(
         # the bus is locked.
         bus.emit(
             "ckpt_save",
-            step=meta.get("step"),
+            step=meta_src.get("step"),
             path=path,
-            nbytes=len(blob),
-            epoch=meta.get("completed_epochs"),
-            wall_s=round(_time.perf_counter() - t0, 6),
+            nbytes=stats["total_bytes"],
+            epoch=meta_src.get("completed_epochs"),
+            wall_s=round(time.perf_counter() - t0, 6),
+            format=stats["format"],
+            new_bytes=stats["new_bytes"],
+            reused_bytes=stats["reused_bytes"],
         )
     return path
+
+
+def _save_state_v2(
+    host_state: Any,
+    path: str,
+    *,
+    metadata: Optional[dict],
+    keep_last: int,
+    fsync: bool,
+    layouts: Any,
+    chunk_bytes: Optional[int],
+) -> dict:
+    """The v2 save: chunks first, refcounts second, manifest third,
+    old-manifest decrement last — a crash at any instant leaves the
+    previous candidate fully restorable and at worst leaks chunks for
+    the orphan sweep (``tools/ckpt_gc.py``), never corrupts."""
+    store = ckpt_store.ChunkStore(
+        ckpt_store.chunk_dir_for(path), fsync=fsync
+    )
+    manifest, stats = ckpt_store.build_manifest(
+        host_state,
+        store,
+        metadata=metadata,
+        layouts=layouts,
+        chunk_bytes=(
+            int(chunk_bytes)
+            if chunk_bytes
+            else int(
+                os.environ.get(
+                    "MDT_CKPT_CHUNK_BYTES", ckpt_store.DEFAULT_CHUNK_BYTES
+                )
+            )
+        ),
+    )
+    new_digests = ckpt_store.manifest_digests(manifest)
+    blob = ckpt_store.manifest_bytes(manifest)
+    new_step = (metadata or {}).get("step")
+    # Increment + manifest replace are ONE critical section (see
+    # ChunkStore.locked): a GC's refs rebuild must never land between
+    # them — it would drop the counts of a manifest it cannot see yet.
+    # The DISPLACED manifest is identified inside the same section
+    # (two overlapping writers each decrement exactly the manifest
+    # THEY displaced — reading it before the lock would double-count
+    # one and skip the other), and a save may only move the primary
+    # FORWARD: under the snapshot-fast drain a drained victim's
+    # delayed background persist of step N can land after its
+    # successor attempt already wrote step N+1 on the same path — the
+    # stale replace is skipped (its chunks leak to the sweep), never
+    # published over newer work.
+    with store.locked():
+        displaced = ckpt_store.read_manifest_file(path)
+        if displaced is not None and new_step is not None:
+            try:
+                cur_step = int(
+                    (displaced.get("meta") or {}).get("step")
+                )
+            except (TypeError, ValueError):
+                cur_step = None
+            if cur_step is not None and cur_step > int(new_step):
+                stats["superseded_by_step"] = cur_step
+                return stats
+        displaced_digests = (
+            ckpt_store.manifest_digests(displaced) if displaced else set()
+        )
+        store._incr_unlocked(new_digests)
+        _write_atomic(path, blob, fsync=fsync)
+        # Sidecar inside the same section: two overlapped writers
+        # must publish {manifest, sidecar} as a pair, or the loser's
+        # late sidecar describes the winner's manifest as torn.
+        meta = dict(metadata) if metadata is not None else {}
+        meta["_integrity"] = {
+            "crc32": zlib.crc32(blob),
+            "nbytes": len(blob),
+        }
+        meta["_format"] = "v2"
+        _write_atomic(
+            path + ".json",
+            json.dumps(meta, indent=2, default=str).encode(),
+            fsync=fsync,
+        )
+    if keep_last > 1:
+        _retain_version(path, meta, keep_last, store=store)
+    store.decr(displaced_digests)
+    return stats
 
 
 def _versions(path: str) -> list[tuple[int, str]]:
@@ -182,20 +418,59 @@ def _versions(path: str) -> list[tuple[int, str]]:
     return out
 
 
-def _retain_version(path: str, meta: dict, keep_last: int) -> None:
+def _retain_version(
+    path: str, meta: dict, keep_last: int, *, store=None
+) -> None:
+    """Retain ``{path}.v{step}`` and prune beyond K. v1 copies the full
+    state blob (independent inode — the scan-back depth contract). v2
+    copies only the MANIFEST (tiny; the chunks are shared across
+    retained versions) and keeps the refcount ledger exact: +1 before
+    the version copy lands, −1 after a pruned version is gone — so
+    eviction can never drop a chunk a retained manifest still
+    references, and a crash in between only leaks a count."""
     step = meta.get("step")
     if step is None:
         existing = _versions(path)
         step = (existing[0][0] + 1) if existing else 1
     ver = f"{path}.v{int(step):010d}"
-    _copy_replace(path, ver)
-    _copy_replace(path + ".json", ver + ".json")
+    if store is not None:
+        with store.locked():
+            # Same critical-section rule as the primary replace: the
+            # displaced same-step version is identified, the new
+            # copy's counts land with the copy, and the {manifest,
+            # sidecar} pair copies together — an overlapped writer
+            # cannot interleave a mismatched pair into the retained
+            # version or double-decrement the displaced one.
+            displaced = ckpt_store.read_manifest_file(ver)
+            m = ckpt_store.read_manifest_file(path)
+            if m is not None:
+                store._incr_unlocked(ckpt_store.manifest_digests(m))
+            _copy_replace(path, ver)
+            _copy_replace(path + ".json", ver + ".json")
+        if displaced is not None:
+            # A re-save at the same step displaced an older same-name
+            # version: its references drop now that the copy replaced
+            # it.
+            store.decr(ckpt_store.manifest_digests(displaced))
+    else:
+        _copy_replace(path, ver)
+        _copy_replace(path + ".json", ver + ".json")
     for _, old in _versions(path)[keep_last:]:
+        old_m = (
+            ckpt_store.read_manifest_file(old) if store is not None else None
+        )
+        removed_manifest = False
         for p in (old, old + ".json"):
             try:
                 os.remove(p)
+                removed_manifest = removed_manifest or p == old
             except OSError:
                 pass
+        if store is not None and old_m is not None and removed_manifest:
+            # Decrement only as the writer that actually removed the
+            # file: two overlapped retentions pruning the same version
+            # must not double-decrement shared chunks toward zero.
+            store.decr(ckpt_store.manifest_digests(old_m))
 
 
 def checkpoint_candidates(path: str) -> list[str]:
@@ -208,10 +483,15 @@ def verify_checkpoint(path: str) -> tuple[bool, Optional[dict], str]:
     """``(ok, metadata, reason)`` for one candidate file.
 
     A candidate is valid when its sidecar parses and the state bytes
-    match the sidecar's CRC32/length. Legacy checkpoints (no
+    match the sidecar's CRC32/length — and, for a v2 manifest, when
+    every referenced chunk is present, sized, and CRC-clean
+    (**chunk-complete verification**: a torn manifest OR a missing/
+    rotted chunk disqualifies the candidate, so scan-back and the
+    cross-host restore agreement degrade to the previous step exactly
+    as they do for a torn v1 state file). Legacy checkpoints (no
     ``_integrity`` — written before this layer existed) fall back to a
-    structural msgpack decode; a missing sidecar is accepted the same
-    way (``restore_state`` never required one).
+    structural decode; a missing sidecar is accepted the same way
+    (``restore_state`` never required one).
     """
     if not os.path.exists(path):
         return False, None, "missing"
@@ -237,11 +517,31 @@ def verify_checkpoint(path: str) -> tuple[bool, Optional[dict], str]:
             )
         if zlib.crc32(blob) != int(integ.get("crc32", -1)):
             return False, meta, "crc32 mismatch — corrupt or torn state"
-        return True, meta, "ok"
+        return _verify_chunks_if_v2(path, blob, meta)
+    if ckpt_store.is_manifest_blob(blob):
+        # Sidecar-less v2 manifest: structural parse + chunk-complete
+        # verification carry the whole verdict.
+        return _verify_chunks_if_v2(path, blob, meta)
     try:  # legacy (pre-CRC) checkpoint: structural check only
         serialization.msgpack_restore(blob)
     except Exception as e:  # noqa: BLE001 — any decode failure disqualifies
         return False, meta, f"msgpack undecodable: {e}"
+    return True, meta, "ok"
+
+
+def _verify_chunks_if_v2(path: str, blob: bytes, meta: Optional[dict]):
+    """The v2 half of :func:`verify_checkpoint`: non-manifest blobs
+    pass through (the sidecar CRC already vouched for them)."""
+    if not ckpt_store.is_manifest_blob(blob):
+        return True, meta, "ok"
+    try:
+        manifest = ckpt_store.load_manifest(blob)
+    except Exception as e:  # noqa: BLE001 — undecodable manifest = torn
+        return False, meta, f"manifest undecodable: {e}"
+    store = ckpt_store.ChunkStore(ckpt_store.chunk_dir_for(path))
+    ok, reason = ckpt_store.verify_manifest_chunks(manifest, store)
+    if not ok:
+        return False, meta, f"chunk-incomplete: {reason}"
     return True, meta, "ok"
 
 
@@ -480,9 +780,26 @@ def restore_state(
         )
     _require_fully_addressable(template, "restore_state")
     with open(path, "rb") as f:
-        restored = serialization.from_bytes(jax.device_get(template), f.read())
+        blob = f.read()
+    if ckpt_store.is_manifest_blob(blob):
+        # v2: reassemble from the chunk store with the parallel read
+        # pool, then device_put straight onto the target sharding — no
+        # intermediate replicated copy.
+        manifest = ckpt_store.load_manifest(blob)
+        store = ckpt_store.ChunkStore(ckpt_store.chunk_dir_for(path))
+        state_dict = ckpt_store.restore_arrays(manifest, store)
+        restored = serialization.from_state_dict(
+            jax.device_get(template), state_dict
+        )
+        fmt = "v2"
+    else:
+        restored = serialization.from_bytes(
+            jax.device_get(template), blob
+        )
+        fmt = "v1"
     if trial is not None:
         restored = trial.device_put(restored, shardings)
+    _count(restores=1)
     from multidisttorch_tpu.telemetry.events import get_bus
 
     bus = get_bus()
@@ -491,5 +808,6 @@ def restore_state(
             "ckpt_restore",
             group_id=getattr(trial, "group_id", None),
             path=path,
+            format=fmt,
         )
     return restored

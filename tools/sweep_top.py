@@ -718,6 +718,38 @@ def render_service(folded, books, state, service_dir: str) -> str:
             f"{pre.get('evicted_slices')}  unblocked "
             f"{len(pre.get('unblocked') or [])}"
         )
+    ck = books.get("checkpoint") or {}
+    if ck.get("saves") or ck.get("pending_persists"):
+        # The checkpoint data plane (docs/RESILIENCE.md "Checkpoint
+        # format v2"): delta ratio = bytes actually written / total
+        # state bytes saved (1.0 = no dedup win), drain split =
+        # slices-freed (snapshot) vs durable (persist) latency.
+        dr = ck.get("delta_ratio")
+        lines.append(
+            f"ckpt  fmt {ck.get('format', '?')}  saves "
+            f"{ck.get('saves', 0)}  written "
+            f"{fmt_bytes(ck.get('bytes_written'))}"
+            f"/{fmt_bytes(ck.get('bytes_total'))}"
+            f"  delta {dr if dr is not None else '-'}"
+            f"  ram-restores {ck.get('restores_ram', 0)}"
+            + (
+                f"  persisting {ck['pending_persists']}"
+                if ck.get("pending_persists")
+                else ""
+            )
+        )
+        for label, key in (
+            ("drain-snapshot", "drain_snapshot"),
+            ("drain-persist", "drain_persist"),
+        ):
+            h = ck.get(key) or {}
+            if h.get("count"):
+                lines.append(
+                    f"{label}  n {h['count']}  p50 "
+                    f"{fmt_duration(h.get('p50_s'))}  p99 "
+                    f"{fmt_duration(h.get('p99_s'))}  max "
+                    f"{fmt_duration(h.get('max_s'))}"
+                )
     dl = books.get("deadline") or {}
     if dl.get("hits") or dl.get("misses") or dl.get("pending"):
         lines.append(

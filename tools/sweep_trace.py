@@ -73,7 +73,12 @@ def render_breakdown(bd: dict) -> str:
         if row["kind"] == "instant":
             dur = "·"
         tag_bits = []
-        for k in ("status", "epoch", "requeued", "unplaced_reason"):
+        # mode/persist_in_flight: the checkpoint drain's snapshot vs
+        # background-persist split (docs/RESILIENCE.md).
+        for k in (
+            "status", "epoch", "requeued", "unplaced_reason",
+            "mode", "persist_in_flight",
+        ):
             if row["tags"].get(k) not in (None, ""):
                 tag_bits.append(f"{k}={row['tags'][k]}")
         tags = ("  [" + ", ".join(tag_bits) + "]") if tag_bits else ""
