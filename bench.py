@@ -2160,8 +2160,16 @@ def main():
         "anti-thrash budget; and a 1M-submission discrete-event "
         "loadgen replay against the pure scheduler core (p99 "
         "placement latency, fairness <= 10%, deadline hit rate, "
-        "churn; MDT_FABRIC_LOADGEN_N overrides the count; banks "
-        "artifacts/bench_fabric_*.json)",
+        "churn; MDT_FABRIC_LOADGEN_N overrides the count); plus the "
+        "elastic-topology drills (docs/SERVICE.md \"Shard "
+        "topology\"): a shard_split_lost fault SIGKILLs the "
+        "splitting replica BETWEEN split-handoff records and the "
+        "adopter must close the seam zero-lost/no-double-own, "
+        "stacked + pipelined placements evict-and-resume "
+        "bit-identical, and the loadgen scenario zoo "
+        "(coordinated_burst, split_storm; MDT_FABRIC_SCENARIO_N "
+        "overrides) holds the elastic arm within 10% of static "
+        "routing (banks artifacts/bench_fabric_*.json)",
     )
     parser.add_argument(
         "--ckpt", action="store_true",
@@ -2711,7 +2719,12 @@ def main():
             run_fabric_bench,
         )
 
-        r = run_fabric_bench(tempfile.mkdtemp(prefix="bench_fabric_"))
+        # The drills run real services in-process and their drivers
+        # narrate (retry resumes etc.) on stdout; bench's stdout
+        # contract is exactly ONE JSON line, so the narration joins
+        # the diagnostics on stderr.
+        with contextlib.redirect_stdout(sys.stderr):
+            r = run_fabric_bench(tempfile.mkdtemp(prefix="bench_fabric_"))
         r["backend"] = backend
         banked = None
         try:
@@ -2730,6 +2743,20 @@ def main():
         except OSError as e:
             print(f"artifact banking failed: {e!r}", file=sys.stderr)
             banked = None
+        # CI-uploadable evidence next to the banked JSON: the split
+        # drill's topology log (the elastic fabric's flight recorder)
+        # and the failover drill's merged trace export.
+        try:
+            import shutil as _sh
+
+            _sh.copy(
+                r["split_chaos"]["topology"]["log_path"],
+                "artifacts/fabric_topology_log.jsonl",
+            )
+            for k, p in r["failover"]["trace"]["exported"].items():
+                _sh.copy(p, f"artifacts/fabric_trace_{k}.json")
+        except (OSError, KeyError) as e:
+            print(f"evidence copy failed: {e!r}", file=sys.stderr)
         lg = r["loadgen"]
         print(
             json.dumps(
@@ -2751,6 +2778,24 @@ def main():
                         "bit_identical"
                     ],
                     "deadline_drill_ok": r["deadline"]["ok"],
+                    # Elastic topology (ISSUE 17): the kill-mid-split
+                    # seam closed by the adopter, movable stacked/
+                    # pipelined placements, scenario zoo within 10%
+                    # of static routing.
+                    "split_kill_exercised": r["split_chaos"][
+                        "split_kill_exercised"
+                    ],
+                    "split_zero_lost": r["split_chaos"]["zero_lost"],
+                    "split_no_double_own": r["split_chaos"][
+                        "no_double_own"
+                    ],
+                    "stacked_evict_resume_bit_identical": r["movable"][
+                        "stacked"
+                    ]["bit_identical"],
+                    "pipelined_evict_resume_bit_identical": r["movable"][
+                        "pipelined"
+                    ]["bit_identical"],
+                    "scenario_gates_ok": r["fabric_scenarios"]["ok"],
                     "fairness_max_abs_ratio_error": lg["fairness"][
                         "max_abs_ratio_error"
                     ],

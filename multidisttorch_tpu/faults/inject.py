@@ -40,6 +40,7 @@ from multidisttorch_tpu.faults.plan import (
     HOST_KINDS,
     HOST_LOST,
     PREEMPT,
+    SHARD_SPLIT_LOST,
     SLOW,
     WEDGE,
     FaultPlan,
@@ -99,6 +100,10 @@ class FaultInjector:
         # counter across ALL trials — the firing clock for host kinds.
         self.host_slot = host_slot
         self._host_steps = 0
+        # The shard-split handoff clock (SHARD_SPLIT_LOST): advanced by
+        # split_step() once per durable handoff record, never by the
+        # dispatch clock.
+        self._split_steps = 0
         # Durable fired state for elastic restarts: an in-memory
         # injector dies with its host, but a one-shot fault must stay
         # one-shot when the supervisor relaunches the world. Every
@@ -214,6 +219,8 @@ class FaultInjector:
         for idx, spec in enumerate(self.plan.specs):
             if spec.kind not in HOST_KINDS or spec.host != self.host_slot:
                 continue
+            if spec.kind == SHARD_SPLIT_LOST:
+                continue  # fires on the split-handoff clock, not this one
             if not self._due(idx, spec) or spec.step >= window_end:
                 continue
             self._record(idx, spec, step=spec.step, host=self.host_slot)
@@ -246,6 +253,32 @@ class FaultInjector:
         shard services own those — but its daemon_lost fault must fire
         on real dispatch progress)."""
         self._host_hook(n_steps)
+
+    def split_step(self, n_steps: int = 1) -> None:
+        """Advance the replica's cumulative SPLIT-HANDOFF clock — one
+        tick per durable ``moved`` record a shard split writes. A due
+        ``shard_split_lost`` fault SIGKILLs the replica HERE, i.e.
+        between two handoff records of a split in flight (the fired
+        record is fsync'd first): the pending topology entry plus a
+        half-transferred queue is exactly the seam the adopting
+        replica must close."""
+        if self.host_slot is None:
+            return
+        window_end = self._split_steps + n_steps
+        self._split_steps = window_end
+        for idx, spec in enumerate(self.plan.specs):
+            if (
+                spec.kind != SHARD_SPLIT_LOST
+                or spec.host != self.host_slot
+            ):
+                continue
+            if not self._due(idx, spec) or spec.step >= window_end:
+                continue
+            self._record(idx, spec, step=spec.step, host=self.host_slot)
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
+            return  # unreachable live; tests monkeypatch os.kill
 
     def step_hook(self, trial_id: int, step: int, n_steps: int = 1) -> None:
         """Called before dispatching ``n_steps`` optimizer steps starting

@@ -41,14 +41,25 @@ DAEMON_LOST = "daemon_lost"  # SIGKILL the targeted service-fabric
                              # (no drain, no cleanup — shard leases go
                              # stale and a surviving replica must adopt
                              # the orphaned shard, docs/SERVICE.md)
+SHARD_SPLIT_LOST = "shard_split_lost"  # SIGKILL the targeted replica on
+                             # its cumulative SPLIT-HANDOFF clock: the
+                             # replica dies BETWEEN two durable handoff
+                             # records of a shard split (after the Nth
+                             # submission's `moved` journal append) —
+                             # the seam the adopting replica must close
+                             # by completing or aborting the pending
+                             # split with no submission lost and none
+                             # double-owned (docs/SERVICE.md "Shard
+                             # topology")
 
 INFRA_KINDS = frozenset({CRASH, PREEMPT, SLOW, DATA_ERROR, CKPT_CORRUPT})
 # Host-scoped kinds fire on ONE host of a multi-host world (FaultSpec
 # .host), keyed to the host's cumulative dispatched-step count instead
 # of a single trial's step — the fault is about the host, not a trial.
 # DAEMON_LOST reads .host as the fabric REPLICA id (the replica's
-# dispatch clock is the firing clock).
-HOST_KINDS = frozenset({HOST_LOST, WEDGE, DAEMON_LOST})
+# dispatch clock is the firing clock); SHARD_SPLIT_LOST reads .host the
+# same way but fires on the replica's split-handoff clock instead.
+HOST_KINDS = frozenset({HOST_LOST, WEDGE, DAEMON_LOST, SHARD_SPLIT_LOST})
 ALL_KINDS = INFRA_KINDS | HOST_KINDS | {DIVERGE}
 
 

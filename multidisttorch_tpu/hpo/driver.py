@@ -1770,6 +1770,17 @@ class _StackedBucketRun:
         self._mkey = f"bucket-g{trial.group_id}"
         self._amon = get_monitor()
         self._cost_done = False
+        # Cooperative bucket drain (the movable-stacked-placements
+        # seam): request_drain() makes run() return at the NEXT round
+        # boundary — every live lane's state then sits at an exact
+        # epoch boundary, which is the only point the classic resume
+        # path restores bit-identically. drain_snapshot() then fetches
+        # each live lane device→host and persists the lane checkpoints
+        # on one background writer (the classic runner's
+        # _ckpt_thread/_ckpt_idle/_join_ckpt protocol, bucket-wide).
+        self._drain_requested = False
+        self._ckpt_thread: Optional[threading.Thread] = None
+        self._ckpt_error: Optional[BaseException] = None
 
         self.model = VAE(
             hidden_dim=template.hidden_dim, latent_dim=template.latent_dim
@@ -1997,6 +2008,81 @@ class _StackedBucketRun:
                     lane, "preempted", error=error_text,
                     summary=self.lane_progress(lane["idx"]),
                 )
+
+    def request_drain(self) -> None:
+        """Arm the cooperative drain: :meth:`run` returns at the next
+        round boundary instead of starting another round."""
+        self._drain_requested = True
+
+    def drain_snapshot(self, idxs, reason: str = "") -> None:
+        """Snapshot every live lane in ``idxs`` at its epoch boundary
+        (the PR 15 snapshot path, all lanes in one pass): each lane's
+        slice is read out of the stacked state (compiled dynamic-index
+        read), fetched device→host, seeded into the RAM snapshot cache
+        (a same-process re-place restores without touching disk), and
+        persisted to its ``trial-{id}/state.msgpack`` on ONE background
+        writer thread — the classic runner's checkpoint protocol,
+        bucket-wide. Callers must have driven :meth:`run` to a round
+        boundary first (:meth:`request_drain`): only a boundary state
+        resumes bit-identically through the classic scan restore."""
+        wanted = set(idxs)
+        jobs = []
+        for k, lane in enumerate(self.lanes):
+            if lane is None or lane["idx"] not in wanted:
+                continue
+            cfg: TrialConfig = lane["cfg"]
+            lane_state = self.read_lane(self.state, np.int32(k))
+            host_state = jax.device_get(lane_state)
+            ckpt = os.path.join(
+                self.out_dir, f"trial-{cfg.trial_id}", "state.msgpack"
+            )
+            meta = {
+                **asdict(cfg),
+                "completed_epochs": lane["epochs_done"],
+                "step": int(host_state.step),
+                "history": list(lane["history"]),
+            }
+            snapshot_cache().put(ckpt, host_state, meta)
+            jobs.append((host_state, ckpt, meta))
+        if not jobs or not self._is_writer:
+            return
+        self._join_ckpt()
+        self._ckpt_thread = threading.Thread(
+            target=self._write_drain_ckpts,
+            args=(jobs, reason),
+            daemon=False,
+        )
+        self._ckpt_thread.start()
+
+    def _write_drain_ckpts(self, jobs, reason: str) -> None:
+        try:
+            for host_state, ckpt, meta in jobs:
+                save_state(
+                    host_state,
+                    ckpt,
+                    metadata=meta,
+                    format=self._ckpt_format,
+                )
+        except BaseException as e:  # re-raised at the next join
+            self._ckpt_error = e
+
+    def _join_ckpt(self) -> None:
+        if self._ckpt_thread is not None:
+            self._ckpt_thread.join()
+            self._ckpt_thread = None
+        if self._ckpt_error is not None:
+            e, self._ckpt_error = self._ckpt_error, None
+            raise RuntimeError(
+                f"stacked bucket g{self.trial.group_id}: drain "
+                "checkpoint write failed"
+            ) from e
+
+    def _ckpt_idle(self) -> bool:
+        """No drain persist in flight (the snapshot-fast drain's
+        non-blocking poll; :meth:`_join_ckpt` is the blocking/raising
+        sibling)."""
+        t = self._ckpt_thread
+        return t is None or not t.is_alive()
 
     def _stacked_fault_hook(self, batch_index: int, stacked):
         """Poison a DIVERGE-covered lane's slice of the (K, B, ...) host
@@ -2342,6 +2428,14 @@ class _StackedBucketRun:
         yield from self._admit_programs()
         n_per_epoch = self.data.samples_per_epoch
         while any(lane is not None for lane in self.lanes):
+            if self._drain_requested:
+                # Cooperative drain: exit at this round boundary —
+                # every live lane's state is at an exact epoch
+                # boundary (epochs_done and history are settled for
+                # the finished round), so drain_snapshot() writes
+                # checkpoints the classic resume replays
+                # bit-identically.
+                return
             # Lane-scoped infra faults due this round fire BEFORE the
             # round dispatches: the faulted lane retires and refills,
             # the others never notice.

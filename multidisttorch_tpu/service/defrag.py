@@ -50,21 +50,38 @@ from multidisttorch_tpu.service.scheduler import SlicePool
 
 @dataclass(frozen=True)
 class PlacedBlock:
-    """The planner's view of one live placement: where it sits and
-    whether it may be moved (the runtime answers ``movable`` from its
-    checkpoint bookkeeping — flushed-to-disk or nothing-to-lose)."""
+    """The planner's view of one live placement block: where it sits
+    and whether its placement may be moved (the runtime answers
+    ``movable`` from its checkpoint bookkeeping — flushed-to-disk or
+    nothing-to-lose). A pipelined placement contributes one record per
+    stage block, all sharing a ``placement_id``.
+
+    ``rehome_sizes`` is what evicting the placement would REQUEUE, as
+    slice sizes: empty means the classic case ``(size,)``; a stacked
+    bucket lists one entry per live lane (each resumes as a classic
+    single); a pipelined vector lists one entry per stage block. The
+    re-home feasibility leg sizes against these units, and a
+    multi-unit victim's move is UNPINNED (``new_start = None``) — the
+    scheduler re-homes each unit wherever it fits."""
 
     placement_id: int
     start: int
     size: int
     movable: bool
+    rehome_sizes: tuple = ()
+
+    def units(self) -> tuple:
+        return self.rehome_sizes or (self.size,)
 
 
 @dataclass
 class DefragPlan:
     """Moves to execute (in order) and the block they open.
 
-    ``moves`` are ``(placement_id, new_start)``; the window
+    ``moves`` are ``(placement_id, new_start)`` — ``new_start`` is the
+    pinned relocation target for a classic single-block victim, or
+    ``None`` for a multi-unit victim (stacked bucket / pipelined
+    vector) whose requeued units re-home unpinned; the window
     ``[window_start, window_start + window_size)`` is the contiguous
     block that becomes free once every victim's old slices are
     released — the freed-slice accounting the ``defrag_end`` event
@@ -72,7 +89,7 @@ class DefragPlan:
 
     window_start: int
     window_size: int
-    moves: list[tuple[int, int]] = field(default_factory=list)
+    moves: list[tuple[int, Optional[int]]] = field(default_factory=list)
 
 
 def plan_defrag(
@@ -99,7 +116,9 @@ def plan_defrag(
             if ln >= want_size:
                 return DefragPlan(window_start=start, window_size=want_size)
     by_slice: dict[int, PlacedBlock] = {}
+    blocks_of: dict[int, list[PlacedBlock]] = {}
     for p in placements:
+        blocks_of.setdefault(p.placement_id, []).append(p)
         for i in range(p.start, p.start + p.size):
             by_slice[i] = p
     free = set(i for start, ln in pool.free_runs()
@@ -129,21 +148,37 @@ def plan_defrag(
         # Re-home every victim in free runs OUTSIDE the window,
         # first-fit over a working copy of the free map (victims'
         # own old slices do NOT count — they free only after the
-        # move, and a plan must be executable move-by-move).
+        # move, and a plan must be executable move-by-move). A victim
+        # that re-homes as SEVERAL units (stacked bucket, pipelined
+        # vector) must fit unit-by-unit; its move is unpinned.
         avail = sorted(i for i in free if i not in window)
         runs = _runs_of(avail)
-        moves: list[tuple[int, int]] = []
+        moves: list[tuple[int, Optional[int]]] = []
         feasible = True
         for pid in sorted(victims):
-            p = victims[pid]
-            spot = _take_run(runs, p.size)
-            if spot is None:
-                feasible = False
-                break
-            moves.append((pid, spot))
+            units = victims[pid].units()
+            if len(units) == 1 and len(blocks_of[pid]) == 1:
+                spot = _take_run(runs, units[0])
+                if spot is None:
+                    feasible = False
+                    break
+                moves.append((pid, spot))
+                continue
+            for u in sorted(units, reverse=True):
+                if _take_run(runs, u) is None:
+                    feasible = False
+                    break
+            else:
+                moves.append((pid, None))
+                continue
+            break
         if not feasible:
             continue
-        cost = sum(victims[pid].size for pid, _ in moves)
+        # The whole placement moves, window-straddling blocks and all:
+        # the cost is every block it occupies, not just the window cut.
+        cost = sum(
+            b.size for pid, _ in moves for b in blocks_of[pid]
+        )
         key = (cost, w0)
         if best is None or key < (best[0], best[1]):
             best = (
@@ -193,7 +228,9 @@ def plan_preemption(
             if ln >= want_size:
                 return PreemptPlan(window_start=start, window_size=want_size)
     by_slice: dict[int, PlacedBlock] = {}
+    blocks_of: dict[int, list[PlacedBlock]] = {}
     for p in placements:
+        blocks_of.setdefault(p.placement_id, []).append(p)
         for i in range(p.start, p.start + p.size):
             by_slice[i] = p
     free = set(
@@ -213,7 +250,12 @@ def plan_preemption(
             victims[p.placement_id] = p
         if not ok or not victims:
             continue
-        cost = sum(p.size for p in victims.values())
+        # Eviction frees the victim's EVERY block (a pipelined vector
+        # drains all-or-nothing), so the lost-progress cost counts all
+        # of them, not just the window cut.
+        cost = sum(
+            b.size for pid in victims for b in blocks_of[pid]
+        )
         if best is None or cost < best.victim_slices:
             best = PreemptPlan(
                 window_start=w0,

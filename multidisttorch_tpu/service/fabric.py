@@ -34,6 +34,22 @@ control-plane argument, PAPERS.md arXiv 2509.07003):
   replica death is a scheduler event with a bounded detection +
   replay cost, drilled by ``bench.py --fabric``.
 
+- **Elastic topology** (PR 17): routing is no longer frozen at
+  ``fabric.json`` creation. ``fabric/topology.jsonl`` (service/
+  topology.py) is an epoch-versioned split/merge log: a hot shard
+  SPLITS its tenant hash range in two (``split_begin`` → fenced
+  handoff of queued-but-unplaced submissions → ``split_commit``),
+  with the whole handoff fenced by the parent shard's lease — a
+  replica killed mid-split leaves a *pending* split the adopting
+  replica completes idempotently or rolls back (``split_abort``).
+  The child shard is not routable until the commit, so no tenant is
+  ever owned by two live shards. Idle replicas also WORK-STEAL
+  queued submissions from a starved shard through a fenced
+  request/grant file (``fabric/shard-k.steal.jsonl``); a stolen
+  submission keeps its origin tenant, so the thief's fair-share
+  scheduler charges the *origin* tenant's vtime — stealing cannot
+  launder priority (docs/SERVICE.md "Shard topology").
+
 No jax at module level: the fabric layer is pure file/lease logic
 (the replica's ``SweepService``s import jax when constructed).
 """
@@ -49,6 +65,7 @@ from typing import Optional
 
 from multidisttorch_tpu.parallel.membership import latest_lease, read_lease
 from multidisttorch_tpu.service import queue as squeue
+from multidisttorch_tpu.service import topology as stopo
 
 FABRIC_DIRNAME = "fabric"
 SHARDS_DIRNAME = "shards"
@@ -57,6 +74,11 @@ CONFIG_NAME = "fabric.json"
 CLAIM = "claim"
 RENEW = "renew"
 RELEASE = "release"
+
+# Transfer provenance kinds (Submission.moved_kind / the journal's
+# ``moved`` record).
+MOVE_SPLIT = "split"
+MOVE_STEAL = "steal"
 
 
 class FenceLost(RuntimeError):
@@ -86,6 +108,39 @@ def lease_file(service_dir: str, shard: int) -> str:
     return os.path.join(
         fabric_dir(service_dir), f"shard-{int(shard)}.lease.jsonl"
     )
+
+
+def steal_file(service_dir: str, shard: int) -> str:
+    """The shard's work-steal ledger: an append-only JSONL of thief
+    ``request`` records and victim ``grant`` records (matched by
+    ``seq``). Grant-INTENT semantics: the victim appends the grant —
+    naming the exact submission ids — BEFORE executing the transfer,
+    so a victim killed mid-steal leaves a grant the adopting replica
+    re-executes idempotently (the split-completion pattern)."""
+    return os.path.join(
+        fabric_dir(service_dir), f"shard-{int(shard)}.steal.jsonl"
+    )
+
+
+def _read_jsonl(path: str) -> list[dict]:
+    """Decodable records in append order, torn tail skipped."""
+    out: list[dict] = []
+    try:
+        f = open(path)
+    except OSError:
+        return out
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
 
 
 def shard_of(tenant: str, n_shards: int) -> int:
@@ -324,10 +379,23 @@ def try_claim(
 
 class FabricClient:
     """Tenant-side API over a sharded fabric: routes each submission
-    to its tenant's shard (:func:`shard_of`) and folds status/wait
-    across shards. The per-shard transport is the PR 9
+    to its tenant's CURRENT owner under the elastic topology
+    (service/topology.py — an empty log routes exactly like the
+    static :func:`shard_of`) and folds status/wait across every live
+    shard. The per-shard transport is the PR 9
     :class:`~multidisttorch_tpu.service.queue.SweepClient` — durable
-    at the rename, no daemon connection."""
+    at the rename, no daemon connection.
+
+    Wrong-shard self-healing: routing is read at submit time, so a
+    split that commits between a client's spool write and the
+    daemon's intake drain lands the submission on a shard that no
+    longer owns the tenant. The daemon rejects it with the
+    ``rejected_wrong_shard`` verdict (never silently re-routes — the
+    journal stays the truth) and the client re-reads the topology and
+    resubmits the SAME submission id to the current owner, bounded to
+    ONE retry per id — topology changes never strand a tenant's
+    spool file, and a flapping topology cannot ping-pong a submission
+    forever."""
 
     def __init__(
         self,
@@ -347,16 +415,26 @@ class FabricClient:
                 )
             n_shards = int(cfg["n_shards"])
         self.n_shards = int(n_shards)
+        self.topology = stopo.load_topology(
+            service_dir, n_base=self.n_shards
+        )
+        # sub_id -> the shard it was resubmitted to (one retry each).
+        self._wrong_shard_retries: dict[str, int] = {}
+
+    def _reload_topology(self) -> None:
+        self.topology = stopo.load_topology(
+            self.service_dir, n_base=self.n_shards
+        )
 
     def _shard_client(self, tenant: str) -> squeue.SweepClient:
-        k = shard_of(tenant, self.n_shards)
+        k = self.topology.route(tenant)
         return squeue.SweepClient(
             shard_dir(self.service_dir, k), tenant=tenant
         )
 
     def shard_for(self, tenant: Optional[str] = None) -> int:
-        return shard_of(
-            self.tenant if tenant is None else tenant, self.n_shards
+        return self.topology.route(
+            self.tenant if tenant is None else tenant
         )
 
     def submit(self, config: dict, *, tenant: Optional[str] = None, **kw):
@@ -366,12 +444,98 @@ class FabricClient:
         self.last_submission = c.last_submission  # the full receipt
         return sid
 
+    @staticmethod
+    def _superseded(rec: dict) -> bool:
+        """True when another shard's journal owns the live story for
+        this id: ``moved`` at the origin (split/steal handoff) and
+        wrong-shard rejections are terminal only AT THAT SHARD."""
+        if rec["state"] == squeue.MOVED:
+            return True
+        return (
+            rec["state"] == squeue.REJECTED
+            and rec.get("status") == squeue.REJECT_WRONG_SHARD
+        )
+
     def _folds(self) -> dict[str, dict]:
+        """Merged fold across every LIVE shard. A transferred id
+        appears in two journals; the destination's live record wins
+        over the origin's terminal ``moved``/wrong-shard record."""
         out: dict[str, dict] = {}
-        for k in range(self.n_shards):
+        for k in self.topology.live_shards():
             d = shard_dir(self.service_dir, k)
-            out.update(squeue.fold_queue(squeue.load_queue(d)))
+            for sid, rec in squeue.fold_queue(
+                squeue.load_queue(d)
+            ).items():
+                rec["shard"] = k
+                cur = out.get(sid)
+                if cur is None:
+                    out[sid] = rec
+                elif self._superseded(cur) and not self._superseded(rec):
+                    out[sid] = rec
+                elif (
+                    self._superseded(cur)
+                    and self._superseded(rec)
+                    and self._wrong_shard_retries.get(sid) == k
+                ):
+                    # Both terminal: the retry destination's verdict
+                    # is the authoritative one (bounded-retry stop).
+                    out[sid] = rec
         return out
+
+    def _retry_wrong_shard(self, folded: dict[str, dict]) -> bool:
+        """The ONE bounded resubmit: for each freshly observed
+        wrong-shard rejection, re-read the topology and spool the SAME
+        submission id to the tenant's current owner. Returns whether
+        anything was resubmitted."""
+        resubmitted = False
+        for sid, rec in folded.items():
+            if rec["state"] != squeue.REJECTED:
+                continue
+            if rec.get("status") != squeue.REJECT_WRONG_SHARD:
+                continue
+            if sid in self._wrong_shard_retries:
+                continue
+            self._reload_topology()
+            owner = self.topology.route(rec.get("tenant", "default"))
+            self._wrong_shard_retries[sid] = owner
+            sub = squeue.Submission(
+                submission_id=sid,
+                tenant=rec.get("tenant", "default"),
+                config=dict(rec.get("config") or {}),
+                priority=int(rec.get("priority", 1)),
+                size=int(rec.get("size", 1)),
+                deadline_s=rec.get("deadline_s"),
+                submit_ts=float(rec.get("submit_ts", 0.0)),
+                trace_id=rec.get("trace_id", ""),
+            )
+            squeue.spool_submission(
+                shard_dir(self.service_dir, owner), sub
+            )
+            _emit(
+                "wrong_shard_resubmit",
+                sub_id=sid,
+                tenant=sub.tenant,
+                to_shard=int(owner),
+                from_shard=rec.get("shard"),
+                trace=sub.trace_id,
+            )
+            resubmitted = True
+        return resubmitted
+
+    def _terminal(self, sid: str, rec: dict) -> bool:
+        if rec["state"] == squeue.SETTLED:
+            return True
+        if rec["state"] != squeue.REJECTED:
+            return False  # PENDING/ADMITTED/PLACED/MOVED: in flight
+        if rec.get("status") != squeue.REJECT_WRONG_SHARD:
+            return True
+        dest = self._wrong_shard_retries.get(sid)
+        if dest is None:
+            return False  # retry not attempted yet this poll
+        # Terminal only when the RETRY itself was rejected (the
+        # one-retry bound); the origin's stale record just means the
+        # destination hasn't drained its spool yet.
+        return rec.get("shard") == dest
 
     def status(self, submission_id: str) -> Optional[dict]:
         # Spool check BEFORE the journal folds — SweepClient.status's
@@ -379,6 +543,7 @@ class FabricClient:
         # durable record first, then unlinks; checking the journals
         # first leaves a window where a committed submission reads as
         # unknown.
+        self._reload_topology()
         spooled = any(
             os.path.exists(
                 os.path.join(
@@ -386,9 +551,15 @@ class FabricClient:
                     submission_id + ".json",
                 )
             )
-            for k in range(self.n_shards)
+            for k in self.topology.live_shards()
         )
-        rec = self._folds().get(submission_id)
+        folded = self._folds()
+        self._retry_wrong_shard(
+            {submission_id: folded[submission_id]}
+            if submission_id in folded
+            else {}
+        )
+        rec = folded.get(submission_id)
         if rec is not None:
             return rec
         if spooled:
@@ -407,7 +578,14 @@ class FabricClient:
     ) -> dict[str, dict]:
         ids = list(submission_ids)
         deadline = time.time() + timeout_s
+        reloaded = 0.0
         while True:
+            now = time.time()
+            if now - reloaded > 1.0:
+                # Splits/merges can commit mid-wait; stale routing
+                # would miss folds from freshly live shards.
+                self._reload_topology()
+                reloaded = now
             folded = self._folds()
             out = {
                 s: folded.get(
@@ -415,10 +593,8 @@ class FabricClient:
                 )
                 for s in ids
             }
-            if all(
-                r["state"] in (squeue.SETTLED, squeue.REJECTED)
-                for r in out.values()
-            ):
+            self._retry_wrong_shard(out)
+            if all(self._terminal(s, r) for s, r in out.items()):
                 return out
             if time.time() > deadline:
                 return out
@@ -454,6 +630,12 @@ class FabricReplica:
         nonpreferred_grace_s: Optional[float] = None,
         injector=None,
         idle_sleep_s: float = 0.02,
+        split_queue_depth: Optional[int] = None,
+        split_trigger=None,
+        split_min_interval_s: float = 2.0,
+        steal_threshold: Optional[int] = None,
+        steal_batch: int = 2,
+        steal_scan_every_s: float = 0.5,
         **svc_kwargs,
     ):
         self.service_dir = service_dir
@@ -500,6 +682,31 @@ class FabricReplica:
         # MONOTONIC across shard drops/adoptions (a summed snapshot
         # goes backwards when a shard is dropped, freezing the clock).
         self._dispatch_seen: dict[int, int] = {}
+        # -- elastic topology (PR 17) --------------------------------
+        # All knobs default OFF: a replica with no split/steal config
+        # behaves byte-identically to the PR 12 static fabric (the
+        # empty topology log IS static routing).
+        self.split_queue_depth = (
+            None if split_queue_depth is None else int(split_queue_depth)
+        )
+        # Optional richer trigger: ``split_trigger(shard, svc) ->
+        # bool`` — e.g. the PR 13 SLO engine's burn verdict.
+        self.split_trigger = split_trigger
+        self.split_min_interval_s = float(split_min_interval_s)
+        self.steal_threshold = (
+            None if steal_threshold is None else int(steal_threshold)
+        )
+        self.steal_batch = int(steal_batch)
+        self.steal_scan_every_s = float(steal_scan_every_s)
+        self.topology = stopo.load_topology(
+            service_dir, n_base=self.n_shards
+        )
+        self._last_topo_load = 0.0
+        self._last_split = 0.0
+        self._last_steal_scan = 0.0
+        self._last_steal_req: dict[int, float] = {}  # victim -> ts
+        self.splits = 0
+        self.steals_granted = 0
 
     # -- shard lifecycle ---------------------------------------------
 
@@ -535,10 +742,20 @@ class FabricReplica:
         # fence_epoch stamps every journal/ledger record this
         # incarnation writes — the submission traces' evidence that a
         # failover's span tree is contiguous across the takeover.
+        def _route_check(tenant: str, _shard: int = shard) -> Optional[int]:
+            # The daemon-side wrong-shard guard: reject a fresh intake
+            # submission whose tenant routes elsewhere under the
+            # CURRENT topology (the client resubmits to the owner).
+            # Moved-in submissions bypass this in _admit — stolen work
+            # intentionally sits at a non-owning shard.
+            owner = self.topology.route(tenant)
+            return owner if owner != _shard else None
+
         svc = SweepService(
             d,
             fence=fence.check,
             fence_epoch=fence.epoch,
+            route_check=_route_check,
             **self.svc_kwargs,
         )
         try:
@@ -568,6 +785,17 @@ class FabricReplica:
             settled_on_adoption=len(svc.settled),
             replay_s=round(time.perf_counter() - t0, 4),
         )
+        # Unfinished business BEFORE the first tick places anything:
+        # a predecessor killed mid-split left a pending topology
+        # record, and one killed mid-steal left a grant-intent without
+        # its transfer — both complete (or roll back) idempotently
+        # here, so the seam a crash opened is closed while the shard's
+        # queue is still exactly as the journal replayed it.
+        try:
+            self._resolve_pending_split(shard)
+            self._recover_steal_grants(shard)
+        except FenceLost as e:
+            self._drop(shard, reason=str(e))
 
     @staticmethod
     def _shutdown_service(svc) -> None:
@@ -611,11 +839,12 @@ class FabricReplica:
                 ap.gen.close()
             except Exception:  # noqa: BLE001 — teardown must go on
                 pass
-            if not ap.stacked:
-                try:
-                    ap.run._join_ckpt()
-                except Exception:  # noqa: BLE001
-                    pass
+            # Classic and stacked runners both persist on a background
+            # writer now; join whichever is in flight.
+            try:
+                ap.run._join_ckpt()
+            except Exception:  # noqa: BLE001
+                pass
         svc.active.clear()
         # Snapshot-drained victims' background persists land in the
         # shared shard dir (they can only HELP the adopter's scan-back)
@@ -649,7 +878,10 @@ class FabricReplica:
         if now - self._last_scan < self.adopt_scan_every_s:
             return
         self._last_scan = now
-        for shard in range(self.n_shards):
+        # Only LIVE shards are claimable: a pending split's child is
+        # not routable and not adoptable until its commit — which is
+        # what makes double-ownership structurally impossible.
+        for shard in self.topology.live_shards():
             if shard in self.services:
                 continue
             if not shard_orphaned(
@@ -677,12 +909,449 @@ class FabricReplica:
             self.adoptions += 1
             self._adopt(shard, fence)
 
+    # -- elastic topology: splits ------------------------------------
+
+    def _reload_topology(
+        self, now: Optional[float] = None, *, force: bool = False
+    ) -> None:
+        if (
+            not force
+            and now is not None
+            and now - self._last_topo_load < self.adopt_scan_every_s
+        ):
+            return
+        self._last_topo_load = time.time() if now is None else now
+        self.topology = stopo.load_topology(
+            self.service_dir, n_base=self.n_shards
+        )
+
+    def _maybe_split(self, now: float) -> None:
+        """Split trigger scan. A shard is HOT when its queue depth
+        crosses ``split_queue_depth`` or the pluggable
+        ``split_trigger(shard, svc)`` (e.g. the PR 13 SLO engine's
+        burn verdict) says so; at most one split per
+        ``split_min_interval_s`` — splitting is load shedding, not a
+        reflex."""
+        # Close any mid-split seam on shards we own first (adoption
+        # resolves most; a topology reload can surface one later).
+        for shard in list(self.services):
+            if self.topology.pending_for(shard) is not None:
+                try:
+                    self._resolve_pending_split(shard)
+                except FenceLost as e:
+                    self._drop(shard, reason=str(e))
+        if self.split_queue_depth is None and self.split_trigger is None:
+            return
+        if now - self._last_split < self.split_min_interval_s:
+            return
+        for shard in sorted(self.services):
+            svc = self.services[shard]
+            hot = (
+                self.split_queue_depth is not None
+                and svc.sched.pending_count() >= self.split_queue_depth
+            )
+            if not hot and self.split_trigger is not None:
+                try:
+                    hot = bool(self.split_trigger(shard, svc))
+                except Exception:  # noqa: BLE001 — a broken trigger
+                    hot = False  # must not take the replica down
+            if not hot:
+                continue
+            self._last_split = now
+            try:
+                self._execute_split(shard)
+            except FenceLost as e:
+                self._drop(shard, reason=str(e))
+            break  # one split per interval
+
+    def _execute_split(self, shard: int) -> None:
+        """Begin + complete one split of ``shard``'s tenant hash
+        range. Both topology appends are first-writer-wins epoch
+        races; the handoff between them is fenced by the parent's
+        lease — every step is crash-safe (see
+        :meth:`_resolve_pending_split` for the recovery half)."""
+        self._reload_topology(force=True)
+        if self.topology.pending_for(shard) is not None:
+            self._resolve_pending_split(shard)
+            return
+        if shard not in self.topology.leaves:
+            return  # stale trigger: shard no longer live
+        fence = self.fences[shard]
+        fence.check()
+        child = self.topology.next_shard_id()
+        won, epoch, topo = stopo.append_topology_event(
+            self.service_dir,
+            {
+                "event": stopo.SPLIT_BEGIN,
+                "parent": int(shard),
+                "child": int(child),
+                "replica": self.replica,
+            },
+        )
+        self.topology = topo
+        if not won:
+            return  # lost the epoch race — re-evaluate next trigger
+        self.splits += 1
+        _emit(
+            "shard_split_begin",
+            shard=int(shard),
+            child=int(child),
+            replica=self.replica,
+            epoch=epoch,
+        )
+        pend = topo.pending_for(shard)
+        if pend is not None:
+            self._complete_split(shard, pend)
+
+    def _complete_split(self, shard: int, pend: stopo.PendingSplit) -> None:
+        """The handoff + commit half: move every queued-but-unplaced
+        submission whose tenant hashes into the child's half (durable
+        spool write, then the parent journal's ``moved`` record — the
+        idempotent transfer primitive), then append ``split_commit``.
+        The injector's split-step clock ticks once per handoff record,
+        which is exactly where the ``shard_split_lost`` chaos kind
+        SIGKILLs the replica."""
+        svc = self.services[shard]
+        fence = self.fences[shard]
+        topo = self.topology
+        parent, child = pend.parent, pend.child
+        _keep, give = topo.split_halves(parent, child)
+        dest = shard_dir(self.service_dir, child)
+
+        def pred(entry) -> bool:
+            return give.matches(
+                stopo.tenant_hash(entry.tenant), topo.n_base
+            )
+
+        on_moved = None
+        if self.injector is not None:
+            on_moved = lambda _sid: self.injector.split_step(1)  # noqa: E731
+        moved = svc.extract_queued(
+            pred,
+            dest_dir=dest,
+            dest_shard=child,
+            from_shard=parent,
+            kind=MOVE_SPLIT,
+            on_moved=on_moved,
+        )
+        fence.check()
+        committed = False
+        for _ in range(8):
+            won, _epoch, topo2 = stopo.append_topology_event(
+                self.service_dir,
+                {
+                    "event": stopo.SPLIT_COMMIT,
+                    "parent": int(parent),
+                    "child": int(child),
+                    "replica": self.replica,
+                },
+            )
+            self.topology = topo2
+            if won:
+                committed = True
+                break
+            if topo2.pending_for(parent) is None:
+                # Resolved concurrently (an adopter beat us to it) —
+                # committed iff the child is live.
+                committed = child in topo2.leaves
+                break
+        if not committed:
+            return
+        _emit(
+            "shard_split_commit",
+            shard=int(parent),
+            child=int(child),
+            replica=self.replica,
+            epoch=self.topology.epoch,
+            moved=len(moved),
+        )
+        # Stragglers admitted between the transfer pass and the
+        # commit: one more idempotent pass (they now route to the
+        # child, so leaving them would strand queued work at a
+        # non-owner until a steal finds it).
+        svc.extract_queued(
+            pred,
+            dest_dir=dest,
+            dest_shard=child,
+            from_shard=parent,
+            kind=MOVE_SPLIT,
+            on_moved=on_moved,
+        )
+        # The splitting replica births the child's service right away
+        # (the orphan scan would get there, but only after the
+        # non-preferred grace).
+        self._try_adopt(child)
+
+    def _resolve_pending_split(self, shard: int) -> None:
+        """Close a predecessor's mid-split seam, idempotently: if the
+        crashed owner moved ANYTHING (journal ``moved`` records toward
+        the child, spool files in the child's intake) or queued work
+        still matches the child's half, re-run the transfer and
+        commit; a no-op split rolls back with ``split_abort`` (the
+        child id is burned, never recycled)."""
+        self._reload_topology(force=True)
+        pend = self.topology.pending_for(shard)
+        if pend is None:
+            return
+        svc = self.services.get(shard)
+        if svc is None:
+            return
+        parent, child = pend.parent, pend.child
+        svc._advance_folds()
+        evidence = any(
+            rec.get("state") == squeue.MOVED
+            and rec.get("moved_to") == child
+            for rec in svc._qfold.values()
+        )
+        if not evidence:
+            try:
+                evidence = any(
+                    n.endswith(".json")
+                    for n in os.listdir(
+                        squeue.intake_dir(
+                            shard_dir(self.service_dir, child)
+                        )
+                    )
+                )
+            except OSError:
+                pass
+        if not evidence:
+            _keep, give = self.topology.split_halves(parent, child)
+            evidence = any(
+                not e.resume_scan
+                and e.pinned_start is None
+                and give.matches(
+                    stopo.tenant_hash(e.tenant), self.topology.n_base
+                )
+                for e in svc.sched.pending_entries()
+            )
+        if evidence:
+            self._complete_split(shard, pend)
+            return
+        for _ in range(8):
+            won, _epoch, topo2 = stopo.append_topology_event(
+                self.service_dir,
+                {
+                    "event": stopo.SPLIT_ABORT,
+                    "parent": int(parent),
+                    "child": int(child),
+                    "replica": self.replica,
+                },
+            )
+            self.topology = topo2
+            if won or topo2.pending_for(parent) is None:
+                break
+        _emit(
+            "shard_split_abort",
+            shard=int(parent),
+            child=int(child),
+            replica=self.replica,
+            epoch=self.topology.epoch,
+        )
+
+    def _try_adopt(self, shard: int) -> None:
+        if shard in self.services:
+            return
+        fence = try_claim(self.service_dir, shard, self.replica)
+        if fence is None:
+            return
+        _emit(
+            "shard_claimed",
+            shard=int(shard),
+            replica=self.replica,
+            epoch=fence.epoch,
+        )
+        self.adoptions += 1
+        self._adopt(shard, fence)
+
+    # -- elastic topology: work stealing ------------------------------
+
+    def _steal_tick(self, now: float) -> None:
+        """Both halves of the steal protocol, one throttled pass:
+        VICTIM — answer every unanswered request on shards we own
+        (grant-intent first, then the fenced transfer); THIEF — when
+        one of our shards is idle with free capacity, append a request
+        to some other live shard's steal file. A stolen submission
+        keeps its origin tenant, so the thief's fair-share scheduler
+        charges the origin tenant's vtime — stealing cannot launder
+        priority."""
+        if self.steal_threshold is None:
+            return
+        if now - self._last_steal_scan < self.steal_scan_every_s:
+            return
+        self._last_steal_scan = now
+        for shard in list(self.services):
+            try:
+                self._serve_steals(shard)
+            except FenceLost as e:
+                self._drop(shard, reason=str(e))
+        idle_shards = [
+            k
+            for k, svc in self.services.items()
+            if not svc.active
+            and svc.sched.pending_count() == 0
+            and svc.pool.free_total > 0
+        ]
+        if not idle_shards:
+            return
+        thief = min(idle_shards)
+        for victim in self.topology.live_shards():
+            if victim == thief:
+                continue
+            if now - self._last_steal_req.get(victim, 0.0) < (
+                4.0 * self.steal_scan_every_s
+            ):
+                continue
+            path = steal_file(self.service_dir, victim)
+            recs = _read_jsonl(path)
+            answered = {
+                r.get("seq") for r in recs if r.get("kind") == "grant"
+            }
+            if any(
+                r.get("kind") == "request"
+                and int(r.get("thief_replica", -1)) == self.replica
+                and r.get("seq") not in answered
+                for r in recs
+            ):
+                continue  # one outstanding request per victim
+            seq = os.urandom(6).hex()
+            _append_lease(
+                path,
+                {
+                    "kind": "request",
+                    "seq": seq,
+                    "thief_shard": int(thief),
+                    "thief_replica": self.replica,
+                    "max_n": self.steal_batch,
+                    "ts": time.time(),
+                },
+            )
+            self._last_steal_req[victim] = now
+            _emit(
+                "steal_request",
+                victim_shard=int(victim),
+                thief_shard=int(thief),
+                replica=self.replica,
+                seq=seq,
+            )
+            break  # one request per pass
+
+    def _serve_steals(self, shard: int) -> None:
+        """Victim side: answer unanswered requests on an owned shard.
+        The grant — naming the exact submission ids — is appended
+        BEFORE the transfer runs, so a crash mid-steal leaves a
+        durable intent the adopter re-executes
+        (:meth:`_recover_steal_grants`). A non-starved victim answers
+        with an empty grant (a refusal the thief's backoff respects)."""
+        svc = self.services.get(shard)
+        fence = self.fences.get(shard)
+        if svc is None or fence is None:
+            return
+        path = steal_file(self.service_dir, shard)
+        recs = _read_jsonl(path)
+        if not recs:
+            return
+        answered = {r.get("seq") for r in recs if r.get("kind") == "grant"}
+        for r in recs:
+            if r.get("kind") != "request" or r.get("seq") in answered:
+                continue
+            sub_ids: list[str] = []
+            if svc.sched.pending_count() >= self.steal_threshold:
+                max_n = max(1, min(int(r.get("max_n", 1)), self.steal_batch))
+                # Steal from the queue's TAIL (newest first): the
+                # oldest entries are closest to placement here.
+                for e in reversed(svc.sched.pending_entries()):
+                    if e.resume_scan or e.pinned_start is not None:
+                        continue
+                    sub_ids.append(e.sub_id)
+                    if len(sub_ids) >= max_n:
+                        break
+            fence.check()
+            _append_lease(
+                path,
+                {
+                    "kind": "grant",
+                    "seq": r.get("seq"),
+                    "sub_ids": sub_ids,
+                    "thief_shard": int(r.get("thief_shard", -1)),
+                    "thief_replica": r.get("thief_replica"),
+                    "epoch": fence.epoch,
+                    "ts": time.time(),
+                },
+            )
+            answered.add(r.get("seq"))
+            _emit(
+                "steal_grant",
+                victim_shard=int(shard),
+                thief_shard=int(r.get("thief_shard", -1)),
+                replica=self.replica,
+                seq=r.get("seq"),
+                n=len(sub_ids),
+            )
+            if sub_ids:
+                moved = self._execute_grant(
+                    shard,
+                    svc,
+                    thief_shard=int(r.get("thief_shard", -1)),
+                    sub_ids=sub_ids,
+                )
+                self.steals_granted += len(moved)
+
+    def _execute_grant(
+        self, shard: int, svc, *, thief_shard: int, sub_ids: list
+    ) -> list:
+        wanted = set(sub_ids)
+        dest = shard_dir(self.service_dir, thief_shard)
+        moved = svc.extract_queued(
+            lambda e: e.sub_id in wanted,
+            dest_dir=dest,
+            dest_shard=int(thief_shard),
+            from_shard=int(shard),
+            kind=MOVE_STEAL,
+        )
+        if moved:
+            _emit(
+                "steal_executed",
+                victim_shard=int(shard),
+                thief_shard=int(thief_shard),
+                replica=self.replica,
+                sub_ids=moved,
+            )
+        return moved
+
+    def _recover_steal_grants(self, shard: int) -> None:
+        """Adoption half of the steal protocol: a grant whose named
+        submissions are STILL queued here never got its transfer (the
+        victim died between intent and execution) — re-run it. A
+        transferred id has a terminal ``moved`` record, so recovery
+        dropped it from the scheduler and this pass skips it: exactly
+        -once handoff from an at-least-once replay."""
+        svc = self.services.get(shard)
+        if svc is None:
+            return
+        for r in _read_jsonl(steal_file(self.service_dir, shard)):
+            if r.get("kind") != "grant" or not r.get("sub_ids"):
+                continue
+            queued = {e.sub_id for e in svc.sched.pending_entries()}
+            still = [s for s in r["sub_ids"] if s in queued]
+            if still:
+                moved = self._execute_grant(
+                    shard,
+                    svc,
+                    thief_shard=int(r.get("thief_shard", -1)),
+                    sub_ids=still,
+                )
+                self.steals_granted += len(moved)
+
     # -- the loop -----------------------------------------------------
 
     def tick(self) -> bool:
         now = time.time()
         self._renew_leases(now)
+        self._reload_topology(now)
         self._scan_orphans(now)
+        self._maybe_split(now)
+        self._steal_tick(now)
         progressed = False
         for shard in list(self.services):
             svc = self.services[shard]
@@ -718,7 +1387,12 @@ class FabricReplica:
         for svc in self.services.values():
             if not svc.idle():
                 return False
-        for shard in range(self.n_shards):
+        if self.topology.pending:
+            # A pending split is unfinished business: someone (this
+            # replica, on its next tick, or an adopter) must complete
+            # or roll it back before the fabric can be called done.
+            return False
+        for shard in self.topology.live_shards():
             if shard in self.services:
                 continue
             d = shard_dir(self.service_dir, shard)
@@ -824,6 +1498,8 @@ class FabricReplica:
             outcome=outcome,
             adoptions=self.adoptions,
             fences_lost=self.fences_lost,
+            splits=self.splits,
+            steals_granted=self.steals_granted,
             wall_s=round(time.time() - t0, 3),
         )
         return {
@@ -831,6 +1507,9 @@ class FabricReplica:
             "replica": self.replica,
             "adoptions": self.adoptions,
             "fences_lost": self.fences_lost,
+            "splits": self.splits,
+            "steals_granted": self.steals_granted,
+            "topology_epoch": self.topology.epoch,
             "wall_s": round(time.time() - t0, 3),
             "settled": settled,
         }
@@ -845,9 +1524,10 @@ def fabric_health(
     cfg = read_fabric_config(service_dir)
     if cfg is None:
         return {"n_shards": 0, "shards": {}}
+    topo = stopo.load_topology(service_dir, n_base=int(cfg["n_shards"]))
     now = time.time()
     shards = {}
-    for k in range(int(cfg["n_shards"])):
+    for k in topo.live_shards():
         rec = shard_owner(service_dir, k)
         if rec is None:
             shards[k] = {"state": "unclaimed"}
@@ -865,4 +1545,8 @@ def fabric_health(
             "epoch": rec.get("epoch"),
             "lease_age_s": round(age, 3),
         }
-    return {"n_shards": int(cfg["n_shards"]), "shards": shards}
+    return {
+        "n_shards": int(cfg["n_shards"]),
+        "shards": shards,
+        "topology": topo.describe(),
+    }

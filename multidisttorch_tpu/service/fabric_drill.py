@@ -646,6 +646,398 @@ def run_loadgen_phase(n_submissions: int, *, seed: int = 0) -> dict:
     return report
 
 
+def _pick_split_tenants(
+    parent: int, n_give: int, n_keep: int
+) -> tuple[list[str], list[str]]:
+    """Deterministic tenant names that route to ``parent`` under the
+    2-shard base topology, partitioned by which HALF of the parent's
+    hash range a first split would hand to the child — the drill must
+    know, before any replica starts, which submissions the handoff
+    will move."""
+    from multidisttorch_tpu.service import topology as stopo
+
+    topo = stopo.Topology(2)
+    _keep, give = topo.split_halves(parent, topo.next_shard_id())
+    gives: list[str] = []
+    keeps: list[str] = []
+    i = 0
+    while len(gives) < n_give or len(keeps) < n_keep:
+        t = f"split{i}"
+        i += 1
+        h = stopo.tenant_hash(t)
+        if h % 2 != parent:
+            continue
+        (gives if give.matches(h, 2) else keeps).append(t)
+    return gives[:n_give], keeps[:n_keep]
+
+
+def run_split_chaos(
+    work_dir: str, *, victim: int = 1, handoff_step: int = 2, seed: int = 0
+) -> dict:
+    """The kill-mid-split chaos drill (the PR 17 tentpole's proof): a
+    seeded ``shard_split_lost`` fault SIGKILLs the SPLITTING replica
+    on its split-handoff clock — strictly between two durable ``moved``
+    records, with the topology's ``split_begin`` durable and its
+    commit not — leaving the exact seam the protocol exists for: a
+    pending split, a half-transferred queue, spool files already in
+    the child's intake. The surviving replica must adopt the orphaned
+    parent shard, find the evidence, COMPLETE the split (re-run the
+    idempotent transfer, append ``split_commit``, birth the child) and
+    settle every submission: zero lost, none double-owned, journals
+    replaying cleanly across the seam."""
+    from multidisttorch_tpu.faults.plan import (
+        SHARD_SPLIT_LOST,
+        FaultPlan,
+        FaultSpec,
+    )
+    from multidisttorch_tpu.service import topology as stopo
+
+    service_dir = os.path.join(work_dir, "fabric_split")
+    shutil.rmtree(service_dir, ignore_errors=True)
+    os.makedirs(service_dir, exist_ok=True)
+    fabric.ensure_fabric_config(service_dir, 2)
+    plan = FaultPlan(
+        specs=(
+            FaultSpec(
+                SHARD_SPLIT_LOST,
+                trial_id=-1,
+                step=int(handoff_step),
+                host=int(victim),
+            ),
+        ),
+        seed=seed,
+    )
+    plan_path = os.path.join(work_dir, "split_fault_plan.json")
+    with open(plan_path, "w") as f:
+        f.write(plan.to_json())
+
+    # 6 give-half + 2 keep-half submissions on the victim's shard: at
+    # kill time (after the 3rd handoff record) give-half work is BOTH
+    # already-moved and still-unmoved — the seam has meat on each
+    # side. Two more on the survivor's home shard keep it honest
+    # about serving while it adopts.
+    gives, keeps = _pick_split_tenants(victim, 6, 2)
+    survivor = 1 - victim
+    surv_tenant = TENANT_SHARD0 if survivor == 0 else TENANT_SHARD1
+    base = dict(batch_size=32, latent_dim=4, log_interval=1000, epochs=2)
+    client = fabric.FabricClient(service_dir, n_shards=2)
+    ids = []
+    for i, t in enumerate(gives + keeps):
+        ids.append(
+            client.submit(
+                {**base, "hidden_dim": 16, "seed": i}, tenant=t
+            )
+        )
+    for i in range(2):
+        ids.append(
+            client.submit(
+                {**base, "hidden_dim": 24, "seed": 50 + i},
+                tenant=surv_tenant,
+            )
+        )
+
+    # Only the victim is armed to split (hair trigger: its 8-deep
+    # backlog crosses depth 4 immediately); the survivor gets the
+    # steal knob instead — once its own shard drains it may lift
+    # queued work off the overloaded shard, and the drill's gates
+    # must hold regardless of how that race lands.
+    procs = []
+    logs = []
+    for rep in (0, 1):
+        log = os.path.join(work_dir, f"split_replica{rep}.log")
+        logs.append(log)
+        extra = (
+            (
+                "--split-queue-depth", "4",
+                "--split-min-interval", "0.25",
+                "--fault-plan", plan_path,
+            )
+            if rep == victim
+            else ("--steal-threshold", "6")
+        )
+        procs.append(
+            _spawn_replica(
+                service_dir,
+                rep,
+                log_path=log,
+                extra=("--max-lanes", "1", *extra),
+            )
+        )
+    (p0, f0), (p1, f1) = procs
+    vproc = p1 if victim == 1 else p0
+    try:
+        final = client.wait(ids, timeout_s=600.0)
+        vproc.wait(timeout=120)
+        for p, _ in procs:
+            if p.poll() is None:
+                p.wait(timeout=180)
+    finally:
+        for p, f in procs:
+            try:
+                if p.poll() is None:
+                    p.terminate()
+                    p.wait(timeout=60)
+            except (OSError, subprocess.TimeoutExpired):
+                p.kill()
+            f.close()
+
+    states = {s: r.get("state") for s, r in final.items()}
+    lost = sorted(
+        s
+        for s in ids
+        if states.get(s) not in (squeue.SETTLED, squeue.REJECTED)
+    )
+    fired = _read_jsonl(
+        os.path.join(service_dir, "fabric", f"fired-{victim}.jsonl")
+    )
+    fired_split = [r for r in fired if r.get("kind") == SHARD_SPLIT_LOST]
+
+    # The topology log is the drill's flight recorder: the victim's
+    # split_begin must be there, and the seam must have CLOSED — a
+    # commit (or, if the kill somehow beat every handoff record, an
+    # abort), with nothing pending in the folded state.
+    events = stopo.load_topology_events(service_dir)
+    by_kind = {}
+    for ev in events:
+        by_kind.setdefault(ev.get("event"), []).append(ev)
+    topo = stopo.load_topology(service_dir, n_base=2)
+    committed = bool(by_kind.get(stopo.SPLIT_COMMIT))
+    live = topo.live_shards()
+
+    # No-double-own, from the durable journals alone: fold EVERY live
+    # shard's queue; each submission may have at most one
+    # non-superseded record across the fabric (superseded = journaled
+    # ``moved`` away, or rejected wrong-shard and retried elsewhere).
+    owners: dict[str, list[int]] = {}
+    moved_split = 0
+    for k in set(live) | {0, 1}:
+        sdir = fabric.shard_dir(service_dir, k)
+        folded = squeue.fold_queue(squeue.load_queue(sdir))
+        for sid, rec in folded.items():
+            if (
+                rec.get("state") == squeue.MOVED
+                and rec.get("moved_kind") == fabric.MOVE_SPLIT
+            ):
+                moved_split += 1
+            if not fabric.FabricClient._superseded(rec):
+                owners.setdefault(sid, []).append(k)
+    double_owned = sorted(
+        sid for sid, ks in owners.items() if len(ks) > 1
+    )
+    unowned = sorted(s for s in ids if not owners.get(s))
+
+    split_kill_exercised = bool(
+        vproc.returncode == -signal.SIGKILL and len(fired_split) >= 1
+    )
+    report = {
+        "plan": json.loads(plan.to_json()),
+        "victim": victim,
+        "victim_exit": vproc.returncode,
+        "split_kill_exercised": split_kill_exercised,
+        "fired_records": fired_split,
+        "submissions": len(ids),
+        "give_tenants": gives,
+        "keep_tenants": keeps,
+        "lost_submissions": lost,
+        "zero_lost": not lost,
+        "completed": sum(
+            1 for r in final.values() if r.get("status") == "completed"
+        ),
+        "no_double_own": not double_owned and not unowned,
+        "double_owned": double_owned,
+        "unowned": unowned,
+        "moved_split_records": moved_split,
+        "topology": {
+            "events": events,
+            "log_path": stopo.topology_path(service_dir),
+            "epoch": topo.epoch,
+            "live_shards": live,
+            "committed": committed,
+            "aborted": bool(by_kind.get(stopo.SPLIT_ABORT)),
+            "seam_closed": not topo.pending,
+            "split_begun": bool(by_kind.get(stopo.SPLIT_BEGIN)),
+        },
+        "fabric_health": fabric.fabric_health(service_dir),
+        "logs": logs,
+    }
+    report["ok"] = bool(
+        split_kill_exercised
+        and not lost
+        and report["no_double_own"]
+        and report["topology"]["split_begun"]
+        and report["topology"]["seam_closed"]
+        and moved_split >= 1
+    )
+    return report
+
+
+def _run_movable_arm(
+    service_dir: str, submissions: list[dict], *, evict: bool, svc_kw: dict
+) -> dict:
+    """One in-process service run of ``submissions``: if ``evict``,
+    checkpoint-drain the placement mid-flight (the defrag/preemption
+    planner's move primitive, called on a placement kind that used to
+    be pinned) once it has durable progress, then run everything —
+    including the requeued victims — to completion."""
+    import contextlib
+
+    from multidisttorch_tpu.hpo.supervision import RetryPolicy
+    from multidisttorch_tpu.service.runtime import SweepService
+
+    shutil.rmtree(service_dir, ignore_errors=True)
+    os.makedirs(service_dir, exist_ok=True)
+    client = squeue.SweepClient(service_dir, tenant="mv")
+    for sub in submissions:
+        client.submit(dict(sub))
+    # The driver narrates retry resumes on stdout; this arm runs
+    # in-process inside `bench.py`, whose stdout contract is exactly
+    # one JSON line — route the narration to stderr with the rest of
+    # the drill diagnostics.
+    with contextlib.redirect_stdout(sys.stderr):
+        svc = SweepService(
+            service_dir,
+            data_rows=128,
+            defrag_enabled=False,
+            retry=RetryPolicy(max_retries=2),
+            **svc_kw,
+        )
+        evicted = False
+        requeued = 0
+        t0 = time.time()
+        while len(svc.settled) < len(submissions) and time.time() - t0 < 600:
+            svc.tick()
+            if evict and not evicted:
+                for ap in list(svc.active.values()):
+                    if ap.stacked:
+                        ready = any(
+                            lane["epochs_done"] >= 1
+                            for lane in ap.run.lanes
+                        )
+                    else:
+                        ready = bool(ap.run.result.checkpoint)
+                    if ready and ap.movable(svc.snapshot_drain):
+                        entries = svc._checkpoint_drain(
+                            ap, reason="movable drill eviction"
+                        )
+                        requeued = len(entries)
+                        evicted = True
+                        break
+        svc._drain(reason="movable drill end")
+    statuses = dict(svc.settled)
+    return {
+        "evicted": evicted,
+        "requeued": requeued,
+        "statuses": statuses,
+        "all_completed": len(statuses) == len(submissions)
+        and all(s == "completed" for s in statuses.values()),
+        "losses": {
+            "|".join(map(str, k)): v
+            for k, v in _final_losses(service_dir).items()
+        },
+    }
+
+
+def run_movable_phase(work_dir: str) -> dict:
+    """Movable stacked buckets and pipelined vectors (the planner's
+    ``movable`` set now covers every placement kind): evict each
+    mid-flight through the checkpoint-drain primitive — the stacked
+    bucket snapshots ALL lanes together at a cooperative round
+    boundary, the pipelined vector drains its stage blocks
+    all-or-nothing — resume, run to completion, and demand the final
+    losses be BIT-IDENTICAL to an undisturbed run of the same
+    configs."""
+    base = dict(batch_size=32, latent_dim=4, log_interval=1000, epochs=4)
+    out: dict = {}
+    arms = {
+        # Two same-shape trials on a 1-slice pool with 2 lanes: they
+        # co-pack into ONE stacked bucket (the only way both run).
+        "stacked": (
+            [
+                {**base, "hidden_dim": 16, "seed": 0},
+                {**base, "hidden_dim": 16, "seed": 1},
+            ],
+            dict(n_slices=1, max_lanes=2),
+        ),
+        # One 2-stage MPMD pipeline on a 2-slice pool: a vector
+        # placement of two stage blocks.
+        "pipelined": (
+            [{**base, "hidden_dim": 16, "seed": 7, "pipeline_stages": 2}],
+            dict(n_slices=2, max_lanes=1),
+        ),
+    }
+    for name, (subs, svc_kw) in arms.items():
+        disturbed = _run_movable_arm(
+            os.path.join(work_dir, f"movable_{name}"),
+            subs,
+            evict=True,
+            svc_kw=svc_kw,
+        )
+        reference = _run_movable_arm(
+            os.path.join(work_dir, f"movable_{name}_ref"),
+            subs,
+            evict=False,
+            svc_kw=svc_kw,
+        )
+        mismatched = sorted(
+            k
+            for k in set(disturbed["losses"]) | set(reference["losses"])
+            if disturbed["losses"].get(k) != reference["losses"].get(k)
+        )
+        out[name] = {
+            "evicted": disturbed["evicted"],
+            "requeued": disturbed["requeued"],
+            "all_completed": disturbed["all_completed"]
+            and reference["all_completed"],
+            "losses": disturbed["losses"],
+            "reference_losses": reference["losses"],
+            "mismatched": mismatched,
+            "bit_identical": bool(
+                disturbed["evicted"]
+                and disturbed["all_completed"]
+                and reference["all_completed"]
+                and len(disturbed["losses"]) == len(subs)
+                and not mismatched
+            ),
+        }
+    out["ok"] = all(
+        out[n]["bit_identical"] for n in ("stacked", "pipelined")
+    )
+    return out
+
+
+def run_scenario_phase(
+    n_submissions: Optional[int] = None, *, seed: int = 0
+) -> dict:
+    """The loadgen scenario zoo over the DYNAMIC topology: every named
+    scenario replays twice — the elastic arm (splits + stealing,
+    routing through the production topology trie) against the
+    static-routing baseline on the identical seeded workload — gated
+    on zero-lost / no-double-own and the elastic arm's p99 placement
+    latency and deadline hit-rate staying within 10% of the static
+    baseline."""
+    from multidisttorch_tpu.service.loadgen import (
+        FABRIC_SCENARIOS,
+        run_fabric_scenario,
+    )
+
+    if n_submissions is None:
+        n_submissions = int(
+            os.environ.get("MDT_FABRIC_SCENARIO_N", "20000") or 20000
+        )
+    scenarios: dict[str, dict] = {}
+    for name in sorted(FABRIC_SCENARIOS):
+        rep = run_fabric_scenario(
+            name, n_submissions=n_submissions, seed=seed
+        )
+        rep["ok"] = all(rep["gates"].values())
+        scenarios[name] = rep
+    return {
+        "n_submissions": n_submissions,
+        "scenarios": scenarios,
+        "ok": all(r["ok"] for r in scenarios.values()),
+    }
+
+
 def run_fabric_bench(
     work_dir: str, *, loadgen_n: Optional[int] = None
 ) -> dict:
@@ -656,8 +1048,11 @@ def run_fabric_bench(
         )
     t0 = time.time()
     failover = run_failover_phase(work_dir)
+    split_chaos = run_split_chaos(work_dir)
+    movable = run_movable_phase(work_dir)
     deadline = run_deadline_phase(work_dir)
     loadgen = run_loadgen_phase(loadgen_n)
+    scenarios = run_scenario_phase()
     gates = {
         "kill_exercised": failover["kill_exercised"],
         "zero_lost_submissions": failover["zero_lost"],
@@ -669,15 +1064,34 @@ def run_fabric_bench(
         # with zero orphan spans, spanning both fence epochs.
         "trace_complete": failover["trace"]["completeness"]["complete"],
         "trace_cross_epoch": failover["trace"]["rehomed_cross_epoch"],
+        # Elastic topology (ISSUE 17): the replica SIGKILLed BETWEEN
+        # split-handoff records, the seam closed by the adopter, zero
+        # lost, none double-owned; stacked + pipelined placements each
+        # evicted-and-resumed bit-identical; the scenario zoo's
+        # elastic arm within 10% of static routing.
+        "split_kill_exercised": split_chaos["split_kill_exercised"],
+        "split_zero_lost": split_chaos["zero_lost"],
+        "split_no_double_own": split_chaos["no_double_own"],
+        "split_seam_closed": split_chaos["topology"]["seam_closed"],
+        "stacked_evict_resume_bit_identical": movable["stacked"][
+            "bit_identical"
+        ],
+        "pipelined_evict_resume_bit_identical": movable["pipelined"][
+            "bit_identical"
+        ],
+        "scenario_gates": scenarios["ok"],
         "deadline_preemption_drill": deadline["ok"],
         "loadgen_gates": loadgen["ok"],
     }
     return {
-        "protocol": "fabric_v1",
+        "protocol": "fabric_v2",
         "wall_s": round(time.time() - t0, 1),
         "failover": failover,
+        "split_chaos": split_chaos,
+        "movable": movable,
         "deadline": deadline,
         "loadgen": loadgen,
+        "fabric_scenarios": scenarios,
         "gates": gates,
         "ok": all(gates.values()),
     }

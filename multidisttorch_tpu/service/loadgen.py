@@ -656,3 +656,544 @@ def run_loadgen(
     elif kw:
         raise ValueError("pass a LoadSpec OR keyword overrides, not both")
     return _Sim(spec).run(progress=progress)
+
+
+# ---------------------------------------------------------------------
+# Fabric loadgen: the same discrete-event discipline over a SHARDED
+# fabric with a DYNAMIC topology (ISSUE 17). Each shard is an
+# independent (SlicePool, FairShareScheduler) pair — one replica's
+# capacity — and tenants route through the PRODUCTION routing trie
+# (service/topology.py's Topology, driven in memory), so a million
+# routing decisions exercise the exact extendible-hashing code the
+# replicas fold from the topology log. The dynamic arm splits hot
+# shards (queue-depth trigger; a split moves queued-but-unplaced
+# matching entries to a fresh shard, the fabric's handoff rule) and
+# work-steals into idle shards (stolen entries KEEP their origin
+# tenant, so the thief's fair share charges the origin lane — the
+# no-priority-laundering property, observable here at scale); the
+# static arm replays the identical workload with both knobs off.
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class FabricLoadSpec:
+    """The sharded replay's knobs (seeded: bit-identical reruns)."""
+
+    scenario: str = "coordinated_burst"
+    n_submissions: int = 20_000
+    seed: int = 0
+    n_base: int = 2              # fabric.json shard count (base cells)
+    slices_per_shard: int = 16
+    max_lanes: int = 4
+    n_tenants: int = 24
+    utilization: float = 1.6     # offered load vs BASE capacity
+    sizes: tuple = ((1, 0.68), (2, 0.22), (4, 0.10))
+    duration_lo_s: float = 4.0
+    duration_hi_s: float = 64.0
+    n_shape_buckets: int = 3
+    deadline_frac: float = 0.15
+    slack_lo: float = 3.0
+    slack_hi: float = 8.0
+    max_pending_per_tenant: int = 256
+    max_total_pending: int = 4096
+    scan_limit: int = 8
+    # Elasticity knobs (the dynamic arm; the static arm zeroes both).
+    dynamic: bool = True
+    split_queue_depth: int = 48
+    split_min_interval_s: float = 60.0   # virtual seconds
+    max_splits: int = 6
+    steal_threshold: int = 8
+    steal_batch: int = 2
+    steal_min_interval_s: float = 5.0
+    # coordinated_burst: fraction of the run during which EVERY
+    # arrival's tenant hashes into shard 0's range, starting at
+    # burst_at (fractions of the arrival horizon).
+    burst_at: float = 0.25
+    burst_frac: float = 0.35
+
+
+FABRIC_SCENARIOS: dict[str, dict] = {
+    # Every tenant spikes one shard's hash range at once: the hot
+    # shard's queue explodes while its peers idle — the shape splits
+    # and stealing exist for.
+    "coordinated_burst": {},
+    # Sustained overload with a hair-trigger split threshold: the
+    # topology must absorb REPEATED splits under load (epochs keep
+    # advancing, routing stays exactly-one-owner throughout).
+    "split_storm": {
+        "utilization": 2.2,
+        "burst_frac": 0.0,
+        "split_queue_depth": 24,
+        "split_min_interval_s": 30.0,
+        "max_splits": 10,
+    },
+}
+
+
+@dataclass
+class _FabShard:
+    pool: SlicePool
+    sched: FairShareScheduler
+    # placement_id -> {"start","size","live": set(sub_ids)}
+    live: dict = field(default_factory=dict)
+
+
+class _FabricSim:
+    """The sharded event loop. Events: ``("arrive", i)`` and
+    ``("done", shard, pid, sub_id)`` (stale if the entry was stolen or
+    split away while queued — impossible once placed: only
+    never-placed entries transfer, the fabric's rule)."""
+
+    def __init__(self, spec: FabricLoadSpec, *, dynamic: bool):
+        from multidisttorch_tpu.service.topology import (
+            SPLIT_BEGIN,
+            SPLIT_COMMIT,
+            Topology,
+            tenant_hash,
+        )
+
+        self.spec = spec
+        self.dynamic = dynamic
+        self._SPLIT_BEGIN, self._SPLIT_COMMIT = SPLIT_BEGIN, SPLIT_COMMIT
+        self._tenant_hash = tenant_hash
+        self.rng = np.random.default_rng(
+            np.random.SeedSequence([spec.seed, 0xFAB])
+        )
+        self.topo = Topology(spec.n_base)
+        self.tenants = [f"t{i:03d}" for i in range(spec.n_tenants)]
+        self.policies = {
+            t: TenantPolicy(
+                weight=1.0, max_pending=spec.max_pending_per_tenant
+            )
+            for t in self.tenants
+        }
+        # Tenants whose hash lands in base cell 0 — the burst's target
+        # range (non-empty for any reasonable n_tenants).
+        self.hot_tenants = [
+            t
+            for t in self.tenants
+            if tenant_hash(t) % spec.n_base == 0
+        ] or self.tenants[:1]
+        self.shards: dict[int, _FabShard] = {
+            k: self._new_shard() for k in self.topo.live_shards()
+        }
+        sizes = np.array([s for s, _ in spec.sizes])
+        probs = np.array([p for _, p in spec.sizes], dtype=float)
+        self._sizes, self._probs = sizes, probs / probs.sum()
+        mean_work = float(
+            (self._sizes * self._probs).sum()
+            * np.exp(
+                (np.log(spec.duration_lo_s) + np.log(spec.duration_hi_s))
+                / 2
+            )
+        )
+        base_capacity = spec.n_base * spec.slices_per_shard
+        self.arrival_rate = spec.utilization * base_capacity / mean_work
+        self.arrival_horizon = spec.n_submissions / self.arrival_rate
+        self.now = 0.0
+        self.heap: list = []
+        self._seq = 0
+        from multidisttorch_tpu.telemetry.metrics import Histogram
+
+        self.latency_hist = Histogram(VIRTUAL_LATENCY_BUCKETS)
+        self.trials: dict[str, _SimTrial] = {}
+        self.latencies: list = []
+        self.rejected: dict[str, int] = {}
+        self.deadline_tagged = 0
+        self.deadline_hits = 0
+        self.completed = 0
+        self.double_completions = 0
+        self.placements = 0
+        self.splits = 0
+        self.steals = 0
+        self._last_split = float("-inf")
+        self._last_steal = float("-inf")
+        self._submitted = 0
+        self._next_pid = 0
+
+    def _new_shard(self) -> _FabShard:
+        return _FabShard(
+            pool=SlicePool(self.spec.slices_per_shard),
+            sched=FairShareScheduler(
+                dict(self.policies),
+                max_total_pending=self.spec.max_total_pending,
+            ),
+        )
+
+    def _push_event(self, t: float, kind: str, *payload) -> None:
+        self._seq += 1
+        heapq.heappush(self.heap, (t, self._seq, kind, payload))
+
+    # -- workload -----------------------------------------------------
+
+    def _pick_tenant(self) -> str:
+        spec = self.spec
+        if spec.burst_frac > 0:
+            t0 = spec.burst_at * self.arrival_horizon
+            t1 = t0 + spec.burst_frac * self.arrival_horizon
+            if t0 <= self.now < t1:
+                return self.hot_tenants[
+                    int(self.rng.integers(0, len(self.hot_tenants)))
+                ]
+        return self.tenants[
+            int(self.rng.integers(0, len(self.tenants)))
+        ]
+
+    def _gen_submission(self, i: int) -> None:
+        spec = self.spec
+        rng = self.rng
+        tenant = self._pick_tenant()
+        shard_id = self.topo.route(tenant)
+        shard = self.shards[shard_id]
+        size = int(rng.choice(self._sizes, p=self._probs))
+        duration = float(
+            np.exp(
+                rng.uniform(
+                    np.log(spec.duration_lo_s),
+                    np.log(spec.duration_hi_s),
+                )
+            )
+        )
+        deadline_ts = None
+        if rng.random() < spec.deadline_frac:
+            deadline_ts = self.now + duration * float(
+                rng.uniform(spec.slack_lo, spec.slack_hi)
+            )
+            self.deadline_tagged += 1
+        bucket = f"b{size}x{int(rng.integers(0, spec.n_shape_buckets))}"
+        sub_id = f"{tenant}-{i}"
+        verdict, _ = shard.sched.admit_verdict(tenant)
+        if verdict != ADMIT:
+            self.rejected[verdict] = self.rejected.get(verdict, 0) + 1
+            return
+        entry = PendingTrial(
+            sub_id=sub_id,
+            tenant=tenant,
+            priority=1,
+            cfg=None,
+            bucket=bucket,
+            size=size,
+            cost=duration * size,
+            submit_ts=self.now,
+            trial_id=i,
+            deadline_ts=deadline_ts,
+        )
+        self.trials[sub_id] = _SimTrial(
+            entry=entry,
+            duration=duration,
+            remaining=duration,
+            arrival=self.now,
+            deadline_ts=deadline_ts,
+        )
+        shard.sched.push(entry, now=self.now)
+
+    # -- placement / completion --------------------------------------
+
+    def _schedule_pass(self, shard_id: int) -> None:
+        shard = self.shards.get(shard_id)
+        if shard is None:
+            return
+        if shard.sched.pending_count() == 0 or shard.pool.free_total == 0:
+            return
+        placed = shard.sched.schedule(
+            shard.pool,
+            max_lanes=self.spec.max_lanes,
+            now=self.now,
+            scan_limit=self.spec.scan_limit,
+        )
+        for p in placed:
+            self.placements += 1
+            self._next_pid += 1
+            pid = self._next_pid
+            rec = {"start": p.start, "size": p.size, "live": set()}
+            shard.live[pid] = rec
+            for e in p.members:
+                st = self.trials[e.sub_id]
+                if st.placed_first is None:
+                    st.placed_first = self.now
+                    self.latencies.append(self.now - st.arrival)
+                    self.latency_hist.observe(
+                        self.now - st.arrival, exemplar=e.sub_id
+                    )
+                st.placed_at = self.now
+                rec["live"].add(e.sub_id)
+                self._push_event(
+                    self.now + st.remaining, "done",
+                    shard_id, pid, e.sub_id,
+                )
+
+    def _member_done(self, shard_id: int, pid: int, sub_id: str) -> None:
+        shard = self.shards.get(shard_id)
+        rec = shard.live.get(pid) if shard is not None else None
+        if rec is None or sub_id not in rec["live"]:
+            return  # stale event
+        rec["live"].discard(sub_id)
+        st = self.trials[sub_id]
+        if st.done_at is not None:
+            self.double_completions += 1  # would mean double-ownership
+            return
+        st.done_at = self.now
+        st.remaining = 0.0
+        self.completed += 1
+        if st.deadline_ts is not None and self.now <= st.deadline_ts:
+            self.deadline_hits += 1
+        if not rec["live"]:
+            del shard.live[pid]
+            shard.pool.free(rec["start"], rec["size"])
+
+    # -- elasticity ---------------------------------------------------
+
+    def _apply_topo(self, event: str, parent: int, child: int) -> bool:
+        ok = self.topo.apply(
+            {
+                "event": event,
+                "parent": parent,
+                "child": child,
+                "epoch": self.topo.epoch + 1,
+            }
+        )
+        if not ok:
+            raise AssertionError(
+                f"topology rejected {event} {parent}->{child}"
+            )
+        return ok
+
+    def _maybe_split(self) -> Optional[int]:
+        spec = self.spec
+        if not self.dynamic or self.splits >= spec.max_splits:
+            return None
+        if self.now - self._last_split < spec.split_min_interval_s:
+            return None
+        for parent in sorted(self.shards):
+            shard = self.shards[parent]
+            if shard.sched.pending_count() < spec.split_queue_depth:
+                continue
+            self._last_split = self.now
+            child = self.topo.next_shard_id()
+            self._apply_topo(self._SPLIT_BEGIN, parent, child)
+            keep, give = self.topo.split_halves(parent, child)
+            dest = self._new_shard()
+            # The fabric's handoff rule: only queued-but-unplaced
+            # entries whose tenant hashes into the child's half move.
+            for e in list(shard.sched.pending_entries()):
+                if give.matches(
+                    self._tenant_hash(e.tenant), self.topo.n_base
+                ):
+                    took = shard.sched.take(e.sub_id)
+                    if took is not None:
+                        dest.sched.push(took, now=self.now)
+            self._apply_topo(self._SPLIT_COMMIT, parent, child)
+            self.shards[child] = dest
+            self.splits += 1
+            return child
+        return None
+
+    def _maybe_steal(self) -> Optional[tuple]:
+        spec = self.spec
+        if not self.dynamic:
+            return None
+        if self.now - self._last_steal < spec.steal_min_interval_s:
+            return None
+        thieves = [
+            k
+            for k, s in self.shards.items()
+            if s.sched.pending_count() == 0
+            and not s.live
+            and s.pool.free_total > 0
+        ]
+        if not thieves:
+            return None
+        victims = sorted(
+            (
+                (s.sched.pending_count(), k)
+                for k, s in self.shards.items()
+                if s.sched.pending_count() >= spec.steal_threshold
+            ),
+            reverse=True,
+        )
+        if not victims:
+            return None
+        thief_id = min(thieves)
+        _, victim_id = victims[0]
+        victim, thief = self.shards[victim_id], self.shards[thief_id]
+        moved = 0
+        # Steal from the queue's tail (newest), keeping the ORIGIN
+        # tenant: the thief's fair-share lane charges that tenant.
+        for e in reversed(victim.sched.pending_entries()):
+            took = victim.sched.take(e.sub_id)
+            if took is not None:
+                thief.sched.push(took, now=self.now)
+                moved += 1
+            if moved >= spec.steal_batch:
+                break
+        if moved:
+            self._last_steal = self.now
+            self.steals += moved
+            return victim_id, thief_id
+        return None
+
+    # -- run ----------------------------------------------------------
+
+    def run(self, *, progress=None) -> dict:
+        spec = self.spec
+        wall0 = time.perf_counter()
+        self._push_event(0.0, "arrive", 0)
+        while self.heap:
+            t, _, kind, payload = heapq.heappop(self.heap)
+            self.now = t
+            dirty: set[int] = set()
+            if kind == "arrive":
+                (i,) = payload
+                self._gen_submission(i)
+                self._submitted += 1
+                if i + 1 < spec.n_submissions:
+                    gap = float(
+                        self.rng.exponential(1.0 / self.arrival_rate)
+                    )
+                    self._push_event(self.now + gap, "arrive", i + 1)
+                if progress is not None and (i + 1) % 50_000 == 0:
+                    progress(i + 1, self)
+                dirty.update(self.shards)
+            else:
+                shard_id, pid, sub_id = payload
+                self._member_done(shard_id, pid, sub_id)
+                dirty.add(shard_id)
+            child = self._maybe_split()
+            if child is not None:
+                dirty.update(self.shards)
+            stolen = self._maybe_steal()
+            if stolen is not None:
+                dirty.update(stolen)
+            for k in dirty:
+                self._schedule_pass(k)
+        wall = time.perf_counter() - wall0
+        return self._report(wall)
+
+    def _report(self, wall: float) -> dict:
+        from multidisttorch_tpu.telemetry.slo import (
+            evaluate_offline,
+            histogram_dict,
+        )
+
+        lat = np.array(self.latencies, dtype=float)
+        unfinished = [
+            s for s, st in self.trials.items() if st.done_at is None
+        ]
+        hist = histogram_dict(self.latency_hist)
+        if self.latency_hist.exemplars:
+            hist["p99_exemplar"] = self.latency_hist.percentile_exemplar(99)
+        done_tagged = sum(
+            1
+            for st in self.trials.values()
+            if st.deadline_ts is not None and st.done_at is not None
+        )
+        slo = evaluate_offline(
+            default_loadgen_slos(),
+            histograms={"placement_latency": hist},
+            event_totals={
+                "deadline": {
+                    "good": self.deadline_hits,
+                    "bad": max(0, done_tagged - self.deadline_hits),
+                }
+            },
+        )
+        return {
+            "arm": "dynamic" if self.dynamic else "static",
+            "submitted": self._submitted,
+            "admitted": len(self.trials),
+            "rejected": dict(self.rejected),
+            "completed": self.completed,
+            "unfinished": len(unfinished),
+            "zero_lost": not unfinished,
+            # Double-ownership would surface as the same submission
+            # completing twice (two shards ran it): the production
+            # topology trie + move-only-queued rule make it 0.
+            "no_double_own": self.double_completions == 0,
+            "double_completions": self.double_completions,
+            "placements": self.placements,
+            "splits": self.splits,
+            "steals": self.steals,
+            "final_shards": sorted(self.shards),
+            "topology_epoch": self.topo.epoch,
+            "sim_span_s": round(self.now, 1),
+            "wall_s": round(wall, 2),
+            "placement_latency_s": {
+                "count": int(lat.size),
+                "p50": round(float(np.percentile(lat, 50)), 3),
+                "p95": round(float(np.percentile(lat, 95)), 3),
+                "p99": round(float(np.percentile(lat, 99)), 3),
+                "max": round(float(lat.max()), 3),
+            } if lat.size else {"count": 0},
+            "placement_latency_hist": hist,
+            "slo": slo,
+            "deadline": {
+                "tagged": self.deadline_tagged,
+                "hits": self.deadline_hits,
+                "hit_rate": round(
+                    self.deadline_hits / max(1, done_tagged), 4
+                ),
+            },
+        }
+
+
+def run_fabric_scenario(
+    name: str,
+    *,
+    n_submissions: Optional[int] = None,
+    seed: int = 0,
+    progress=None,
+    **overrides,
+) -> dict:
+    """Run one NAMED fabric scenario (:data:`FABRIC_SCENARIOS`) as a
+    two-arm comparison — the dynamic-topology arm (splits + stealing)
+    against the static-routing baseline over the identical seeded
+    workload — and return the banked verdict: per-arm reports, SLO
+    verdicts, and the within-10% p99/deadline gates the chaos drill
+    and CI assert on."""
+    if name not in FABRIC_SCENARIOS:
+        raise ValueError(
+            f"unknown fabric scenario {name!r}; expected one of "
+            f"{sorted(FABRIC_SCENARIOS)}"
+        )
+    kw = dict(FABRIC_SCENARIOS[name])
+    kw.update(overrides)
+    kw["scenario"] = name
+    kw["seed"] = seed
+    if n_submissions is not None:
+        kw["n_submissions"] = int(n_submissions)
+    spec = FabricLoadSpec(**kw)
+    dyn = _FabricSim(spec, dynamic=True).run(progress=progress)
+    sta = _FabricSim(spec, dynamic=False).run(progress=progress)
+    d99 = dyn["placement_latency_s"].get("p99")
+    s99 = sta["placement_latency_s"].get("p99")
+    p99_ok = (
+        d99 is not None
+        and s99 is not None
+        and d99 <= s99 * 1.10 + 1e-9
+    )
+    dh = dyn["deadline"]["hit_rate"]
+    sh = sta["deadline"]["hit_rate"]
+    deadline_ok = dh >= sh * 0.90 - 1e-9
+    return {
+        "protocol": "fabric_loadgen_v1",
+        "scenario": name,
+        "spec": {
+            "n_submissions": spec.n_submissions,
+            "seed": spec.seed,
+            "n_base": spec.n_base,
+            "slices_per_shard": spec.slices_per_shard,
+            "utilization": spec.utilization,
+            "split_queue_depth": spec.split_queue_depth,
+            "steal_threshold": spec.steal_threshold,
+            "burst_at": spec.burst_at,
+            "burst_frac": spec.burst_frac,
+        },
+        "dynamic": dyn,
+        "static": sta,
+        "gates": {
+            "zero_lost": dyn["zero_lost"] and sta["zero_lost"],
+            "no_double_own": dyn["no_double_own"],
+            "p99_within_10pct_of_static": p99_ok,
+            "deadline_within_10pct_of_static": deadline_ok,
+        },
+    }

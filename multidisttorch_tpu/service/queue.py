@@ -79,11 +79,24 @@ def fsync_dir(path: str) -> None:
 # Submission lifecycle states, in order. ``rejected`` is terminal like
 # ``settled``; ``unplaced`` folds back to ``admitted`` (the trial is
 # queued again — a drain or a defrag migration took it off its submesh).
+# ``moved`` is terminal FOR THIS JOURNAL only: a shard split handoff or
+# a cross-shard steal transferred the submission to another shard's
+# intake, so its live record continues in the destination's journal
+# (the fabric client's merged fold prefers the destination record —
+# docs/SERVICE.md "Shard topology").
 PENDING = "pending"        # submitted, not yet through admission
 ADMITTED = "admitted"      # passed admission; waiting for a submesh
 PLACED = "placed"          # running on a submesh
 SETTLED = "settled"        # terminal trial outcome recorded
 REJECTED = "rejected"      # admission verdict said no
+MOVED = "moved"            # transferred to another shard (split/steal)
+
+# Admission verdict for a submission spooled at a shard that no longer
+# owns its tenant (the topology changed between the client's routing
+# read and the daemon's drain). Terminal at THIS shard; the fabric
+# client re-reads the topology and resubmits to the current owner,
+# bounded to one retry (ISSUE 17 satellite).
+REJECT_WRONG_SHARD = "rejected_wrong_shard"
 
 
 @dataclass(frozen=True)
@@ -115,6 +128,16 @@ class Submission:
     # journal/ledger/telemetry record after it. Empty = an old client;
     # readers derive a deterministic fallback (``trace`` property).
     trace_id: str = ""
+    # Transfer provenance (shard splits / work stealing): the shard
+    # this submission was journaled ``moved`` out of, and why
+    # ("split" | "steal"). A moved submission already passed admission
+    # at its origin, so the destination re-admits it WITHOUT quota or
+    # backpressure checks (a transfer must never turn an accepted
+    # submission into a rejection) — and its tenant/priority/submit_ts
+    # ride along unchanged, so fair-share vtime still charges the
+    # ORIGIN tenant: stealing can't launder priority.
+    moved_from: Optional[int] = None
+    moved_kind: str = ""
 
     @property
     def trace(self) -> str:
@@ -136,6 +159,10 @@ class Submission:
         if self.trace_id:
             # Absent when unset: pre-trace records stay byte-identical.
             d["trace_id"] = self.trace_id
+        if self.moved_from is not None:
+            # Absent when unset: untransferred records stay identical.
+            d["moved_from"] = int(self.moved_from)
+            d["moved_kind"] = self.moved_kind
         return d
 
     @classmethod
@@ -153,6 +180,12 @@ class Submission:
             ),
             submit_ts=float(d.get("submit_ts", 0.0)),
             trace_id=str(d.get("trace_id", "") or ""),
+            moved_from=(
+                int(d["moved_from"])
+                if d.get("moved_from") is not None
+                else None
+            ),
+            moved_kind=str(d.get("moved_kind", "") or ""),
         )
 
 
@@ -162,6 +195,32 @@ def intake_dir(service_dir: str) -> str:
 
 def queue_path(service_dir: str) -> str:
     return os.path.join(service_dir, QUEUE_NAME)
+
+
+def spool_submission(service_dir: str, sub: Submission) -> str:
+    """Durably land ``sub`` in ``service_dir``'s intake spool; returns
+    the spool path. The ONE spool-write primitive: ``SweepClient.
+    submit`` (fresh ids), the fabric client's wrong-shard resubmit
+    (SAME id, new shard), and shard split/steal handoffs (same id +
+    provenance) all commit through it — tmp + fsync + rename + dir
+    fsync, idempotent per submission id (a re-run overwrites the same
+    spool file with the same content, which the journal's id dedup
+    absorbs)."""
+    d = intake_dir(service_dir)
+    os.makedirs(d, exist_ok=True)
+    final = os.path.join(d, sub.submission_id + ".json")
+    tmp = final + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(sub.to_dict(), f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, final)  # the commit point
+    # Directory fsync AFTER the rename: without it the commit point
+    # itself can vanish on a crash (the rename sits only in the page
+    # cache). The call sequence — file fsync, rename, dir fsync — is
+    # regression-tested (tests/test_fabric.py).
+    fsync_dir(d)
+    return final
 
 
 class SweepClient:
@@ -207,20 +266,7 @@ class SweepClient:
             # inside the trace — a daemon-side mint could never see it.
             trace_id=mint_trace_id(),
         )
-        d = intake_dir(self.service_dir)
-        os.makedirs(d, exist_ok=True)
-        final = os.path.join(d, sub.submission_id + ".json")
-        tmp = final + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(sub.to_dict(), f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, final)  # the commit point
-        # Directory fsync AFTER the rename: without it the commit
-        # point itself can vanish on a crash (the rename sits only in
-        # the page cache). The call sequence — file fsync, rename, dir
-        # fsync — is regression-tested (tests/test_fabric.py).
-        fsync_dir(d)
+        spool_submission(self.service_dir, sub)
         # The full receipt (submission + trace id) for callers that
         # want more than the id — tools/sweep_submit.py prints both.
         self.last_submission = sub
@@ -469,6 +515,26 @@ class SubmissionQueue:
             }
         )
 
+    def moved(
+        self, sub_id: str, *, to_shard: int, kind: str, trial_id=None
+    ) -> None:
+        """The submission was transferred to another shard's intake
+        (``kind`` = "split" handoff or "steal" grant). Appended only
+        AFTER the destination spool write is durable, so a crash
+        between the two re-runs the transfer idempotently (the spool
+        overwrite + the destination journal's id dedup absorb the
+        replay) — the submission is never lost and, because a
+        ``moved`` record is terminal at this shard, never runs twice."""
+        rec = {
+            "event": "moved",
+            "submission_id": sub_id,
+            "to_shard": int(to_shard),
+            "kind": kind,
+        }
+        if trial_id is not None:
+            rec["trial_id"] = int(trial_id)
+        self.append(rec)
+
     def settled(
         self, sub_id: str, *, trial_id: int, status: str, error: str = ""
     ) -> None:
@@ -579,6 +645,11 @@ def fold_queue_into(
                 "ts": {"submitted": ev.get("ts")},
                 "placements": 0,
             }
+            if sub.get("moved_from") is not None:
+                # Transfer provenance survives the fold so a restarted
+                # DESTINATION daemon re-admits without quota checks.
+                out[sid]["moved_from"] = int(sub["moved_from"])
+                out[sid]["moved_kind"] = sub.get("moved_kind", "")
             continue
         sid = ev.get("submission_id")
         rec = out.get(sid)
@@ -604,6 +675,10 @@ def fold_queue_into(
         elif kind == "unplaced":
             rec["state"] = ADMITTED
             rec["unplaced_reason"] = ev.get("reason", "")
+        elif kind == "moved":
+            rec["state"] = MOVED
+            rec["moved_to"] = ev.get("to_shard")
+            rec["moved_kind"] = ev.get("kind", "")
         elif kind == "settled":
             rec["state"] = SETTLED
             rec["status"] = ev.get("status", "?")

@@ -245,30 +245,36 @@ class _Active:
         return list(self.blocks) if self.blocks else [(self.start, self.size)]
 
     def movable(self, snapshot_drain: bool = False) -> bool:
-        """Defrag victim eligibility, decided at PLAN time: single
-        runs only (stacked lanes checkpoint at retirement, so a moved
-        bucket would lose every live lane's progress), and never with
-        an UNFLUSHED checkpoint the drain cannot account for.
-        Precisely: movable iff (a durable checkpoint exists OR the
-        trial has made no optimizer step — nothing to lose) AND, in
-        the legacy join-drain mode, no checkpoint write is in flight.
-        Under the snapshot-fast drain an in-flight write is ADOPTED
-        instead of blocking eligibility — it lands in the background
-        before the victim's ``preempted`` record, the same-process
-        re-place prefers the newer RAM snapshot, and the save path's
-        step guard keeps a stale late persist from replacing a
-        successor's newer manifest — migration still never rolls back
-        past it."""
-        if self.stacked:
-            return False
-        if self.blocks is not None and len(self.blocks) > 1:
-            # A pipelined trial occupies several blocks with live
-            # inter-stage transfer edges; migrating one stage would
-            # strand the others mid-schedule. Defrag routes around it.
-            return False
+        """Defrag/preemption victim eligibility, decided at PLAN time:
+        never with an UNFLUSHED checkpoint the drain cannot account
+        for. Precisely: movable iff (a durable checkpoint exists OR
+        the trial has made no optimizer step — nothing to lose) AND,
+        in the legacy join-drain mode, no checkpoint write is in
+        flight. Under the snapshot-fast drain an in-flight write is
+        ADOPTED instead of blocking eligibility — it lands in the
+        background before the victim's ``preempted`` record, the
+        same-process re-place prefers the newer RAM snapshot, and the
+        save path's step guard keeps a stale late persist from
+        replacing a successor's newer manifest — migration still never
+        rolls back past it.
+
+        Stacked buckets and pipelined stage-vectors are movable too
+        (ISSUE 17): the drain itself snapshots every live stacked lane
+        at its epoch boundary (``drain_snapshot`` — the PR 15 snapshot
+        path, all K lanes together), and a pipelined vector drains its
+        whole stage set all-or-nothing through the runner's existing
+        per-stage checkpoints — so neither kind can lose progress a
+        drain did not first make durable. A stacked bucket is only
+        deferred while a lane-retirement persist is in flight under
+        the legacy join-drain (the snapshot drain adopts it)."""
         run = self.run
         t = getattr(run, "_ckpt_thread", None)
         in_flight = t is not None and t.is_alive()
+        if self.stacked:
+            # The bucket drain writes every live lane's snapshot
+            # itself, so there is no "no durable checkpoint" case —
+            # only the in-flight-write rule applies.
+            return snapshot_drain or not in_flight
         if in_flight and not snapshot_drain:
             return False  # unflushed checkpoint write in flight
         has_ckpt = bool(run.result.checkpoint) or in_flight
@@ -331,6 +337,7 @@ class SweepService:
         preempt: Optional[PreemptionPolicy] = None,
         fence=None,
         fence_epoch: Optional[int] = None,
+        route_check=None,
         slos=None,
         retry: Optional[RetryPolicy] = None,
         save_checkpoints: bool = True,
@@ -375,6 +382,15 @@ class SweepService:
         # trace builder's evidence that a submission's span tree is
         # contiguous across a lease takeover.
         self.fence_epoch = fence_epoch
+        # Topology routing check (fabric replicas): a callable
+        # ``tenant -> Optional[int]`` returning the shard id the tenant
+        # ACTUALLY routes to when it is not this service's shard, else
+        # None. A submission spooled here after a split moved its
+        # tenant away gets an explicit ``rejected_wrong_shard`` verdict
+        # naming the owner — the fabric client re-reads the topology
+        # and resubmits there (one bounded retry). None disables the
+        # check (plain single-shard service).
+        self.route_check = route_check
         self.queue = squeue.SubmissionQueue(
             service_dir, fence=fence, epoch=fence_epoch
         )
@@ -567,6 +583,12 @@ class SweepService:
             if rec["state"] in (squeue.SETTLED, squeue.REJECTED):
                 self.settled[sid] = rec.get("status") or rec["state"]
                 continue
+            if rec["state"] == squeue.MOVED:
+                # Terminal AT THIS SHARD: the submission's live record
+                # continues in the destination shard's journal (split
+                # handoff / steal grant) — re-admitting it here would
+                # double-own it.
+                continue
             sub = squeue.Submission.from_dict(
                 {
                     "submission_id": sid,
@@ -577,6 +599,8 @@ class SweepService:
                     "deadline_s": rec.get("deadline_s"),
                     "submit_ts": rec["submit_ts"],
                     "trace_id": rec.get("trace_id", ""),
+                    "moved_from": rec.get("moved_from"),
+                    "moved_kind": rec.get("moved_kind", ""),
                 }
             )
             if rec["state"] == squeue.PENDING:
@@ -773,7 +797,45 @@ class SweepService:
         )
 
     def _admit(self, sub: squeue.Submission) -> None:
-        verdict, reason = self.sched.admit_verdict(sub.tenant)
+        if self.route_check is not None and sub.moved_from is None:
+            # Wrong-shard check FIRST (skipped for transferred
+            # submissions: a steal intentionally lands work at a shard
+            # the tenant does not route to). The verdict names the
+            # owner so the client's one-retry resubmit needs no second
+            # topology read to find it.
+            try:
+                owner = self.route_check(sub.tenant)
+            except Exception:  # noqa: BLE001 — routing must not crash intake
+                owner = None
+            if owner is not None:
+                self.queue.rejected(
+                    sub.submission_id,
+                    verdict=squeue.REJECT_WRONG_SHARD,
+                    reason=(
+                        f"tenant {sub.tenant!r} routes to shard "
+                        f"{int(owner)} under the current topology"
+                    ),
+                )
+                self.settled[sub.submission_id] = squeue.REJECT_WRONG_SHARD
+                _emit(
+                    "submission_rejected",
+                    sub_id=sub.submission_id,
+                    tenant=sub.tenant,
+                    verdict=squeue.REJECT_WRONG_SHARD,
+                    reason=f"owner shard {int(owner)}",
+                    owner_shard=int(owner),
+                    trace=sub.trace,
+                )
+                return
+        if sub.moved_from is not None:
+            # A transferred submission already passed admission at its
+            # origin shard: quota/backpressure must not turn the
+            # handoff into a rejection (the no-lost-submissions leg of
+            # the split contract). Config validity is still re-checked
+            # below — the entry build is what assigns the trial id.
+            verdict, reason = ADMIT, ""
+        else:
+            verdict, reason = self.sched.admit_verdict(sub.tenant)
         if verdict == ADMIT:
             tid = self.next_trial_id
             try:
@@ -837,6 +899,93 @@ class SweepService:
         )
         self._prefetch_data(entry)
         self._warm(entry)
+
+    # -- cross-shard transfer (split handoffs / work stealing) --------
+
+    def extract_queued(
+        self,
+        predicate,
+        *,
+        dest_dir: str,
+        dest_shard: int,
+        from_shard: int,
+        kind: str,
+        max_n: Optional[int] = None,
+        on_moved=None,
+    ) -> list[str]:
+        """Durably hand queued-but-unplaced submissions to another
+        shard; returns the moved submission ids. The ONE transfer
+        primitive split handoffs and steal grants share.
+
+        Only NEVER-PLACED entries move (no ``resume_scan``, no pinned
+        relocation target): an ever-placed trial's checkpoints live
+        under THIS shard's directory, and moving its submission would
+        orphan them. Per entry, the order is the no-loss/no-double-own
+        core: (1) spool the reconstructed submission — same id, origin
+        provenance — into the destination's intake (durable rename);
+        (2) append our journal's ``moved`` record (fenced); (3) drop it
+        from the scheduler and the live bookkeeping. A crash between
+        (1) and (2) re-runs the transfer idempotently on adoption (the
+        spool overwrite and the destination's id dedup absorb the
+        replay); a crash after (2) leaves a terminal ``moved`` record
+        recovery skips. ``on_moved(sub_id)`` fires after each journal
+        append — the chaos drill's kill-mid-split seam."""
+        self._advance_folds()
+        moved: list[str] = []
+        for entry in list(self.sched.pending_entries()):
+            if max_n is not None and len(moved) >= max_n:
+                break
+            if entry.resume_scan or entry.pinned_start is not None:
+                continue
+            if not predicate(entry):
+                continue
+            rec = self._qfold.get(entry.sub_id)
+            if rec is None or not rec.get("config"):
+                continue  # fold raced; leave it for the next pass
+            sub = squeue.Submission(
+                submission_id=entry.sub_id,
+                tenant=entry.tenant,
+                config=dict(rec["config"]),
+                priority=entry.priority,
+                # The ORIGINAL per-stage footprint (entry.size is the
+                # stage total for pipelined vectors).
+                size=int(rec.get("size", entry.size)),
+                deadline_s=rec.get("deadline_s"),
+                submit_ts=entry.submit_ts,
+                trace_id=entry.trace_id or "",
+                moved_from=int(from_shard),
+                moved_kind=kind,
+            )
+            squeue.spool_submission(dest_dir, sub)
+            self.queue.moved(
+                entry.sub_id,
+                to_shard=int(dest_shard),
+                kind=kind,
+                trial_id=entry.trial_id,
+            )
+            self.sched.take(entry.sub_id)
+            tid = entry.trial_id
+            for d in (
+                self.entries, self.attempts, self.chashes,
+                self.infra_fails, self.ledger.tags,
+            ):
+                d.pop(tid, None)
+            self._defrag_targets.discard(entry.sub_id)
+            self._preempt_targets.discard(entry.sub_id)
+            moved.append(entry.sub_id)
+            _emit(
+                "submission_moved",
+                sub_id=entry.sub_id,
+                trial_id=tid,
+                tenant=entry.tenant,
+                from_shard=int(from_shard),
+                to_shard=int(dest_shard),
+                move_kind=kind,
+                trace=entry.trace_id,
+            )
+            if on_moved is not None:
+                on_moved(entry.sub_id)
+        return moved
 
     # -- per-submission datasets -------------------------------------
 
@@ -1534,14 +1683,20 @@ class SweepService:
                 # completions do. Defrag would be pure churn.
                 continue
             blocks = [
-                # A pipelined placement contributes one (immovable)
-                # record per stage block — the planner must see every
-                # slice it occupies, not just the first stage's.
+                # A pipelined placement contributes one record per
+                # stage block — the planner must see every slice it
+                # occupies, not just the first stage's. rehome_sizes
+                # is what evicting the placement would REQUEUE (K
+                # singles for a stacked bucket, one block per stage
+                # for a vector): the planner's re-home feasibility
+                # check sizes against it, and multi-unit victims get
+                # unpinned (pid, None) moves.
                 PlacedBlock(
                     placement_id=pid,
                     start=bstart,
                     size=bsize,
                     movable=ap.movable(self.snapshot_drain),
+                    rehome_sizes=self._rehome_sizes(ap),
                 )
                 for pid, ap in self.active.items()
                 for bstart, bsize in ap.free_blocks()
@@ -1561,6 +1716,25 @@ class SweepService:
             self._execute_defrag(plan, starved, now)
             return  # one defrag per cooldown window
 
+    def _rehome_sizes(self, ap: _Active) -> tuple:
+        """What evicting this placement would requeue, as slice sizes:
+        one entry per live stacked lane (each resumes as a classic
+        single), every stage block of a pipelined vector, or the one
+        classic block."""
+        if ap.stacked:
+            results = ap.run.results
+            return tuple(
+                e.size
+                for tid, e in ap.entries.items()
+                if not (
+                    results.get(tid) is not None
+                    and results[tid].status in SETTLED_STATUSES
+                )
+            ) or (ap.size,)
+        if ap.blocks is not None and len(ap.blocks) > 1:
+            return tuple(int(sz) for _, sz in ap.blocks)
+        return (ap.size,)
+
     def _execute_defrag(self, plan, starved: PendingTrial, now) -> None:
         t0 = time.perf_counter()
         self._last_defrag_ts = now
@@ -1579,7 +1753,7 @@ class SweepService:
         moved = 0
         for pid, new_start in plan.moves:
             ap = self.active.get(pid)
-            if ap is None or ap.stacked:
+            if ap is None:
                 continue  # raced a completion; window may open anyway
             # The victim re-enters the queue FRONT, pinned to the
             # planner's relocation target (outside the window); the
@@ -1587,31 +1761,37 @@ class SweepService:
             # pin before the starved trial claims the opened window.
             # No pre-reservation: the pool must show the window free
             # or the starved trial's own allocation would fail.
+            # A ``None`` target is an UNPINNED move — stacked buckets
+            # (K lanes requeue as K singles) and pipelined vectors
+            # (stage blocks re-place all-or-nothing wherever they fit)
+            # cannot be pinned to one start; they still requeue FRONT
+            # so they re-home before the starved trial's claim.
             # (Snapshot-fast drain: the requeue happens inside
             # _checkpoint_drain — only the ledger record waits for
             # the victim's background persist.)
-            entry = self._checkpoint_drain(
+            entries = self._checkpoint_drain(
                 ap,
                 reason="defrag migration",
                 pinned_start=new_start,
                 front=True,
             )
-            _emit(
-                "defrag_move",
-                trial_id=entry.trial_id,
-                sub_id=entry.sub_id,
-                tenant=entry.tenant,
-                src=ap.start,
-                dst=new_start,
-                size=ap.size,
-            )
-            _emit(
-                "trial_migrated",
-                trial_id=entry.trial_id,
-                src_group=ap.start,
-                dst_group=new_start,
-                reason="defrag",
-            )
+            for entry in entries:
+                _emit(
+                    "defrag_move",
+                    trial_id=entry.trial_id,
+                    sub_id=entry.sub_id,
+                    tenant=entry.tenant,
+                    src=ap.start,
+                    dst=new_start,
+                    size=entry.size,
+                )
+                _emit(
+                    "trial_migrated",
+                    trial_id=entry.trial_id,
+                    src_group=ap.start,
+                    dst_group=new_start,
+                    reason="defrag",
+                )
             moved += ap.size
         self._defrag_count += 1
         self._defrag_moved_slices += moved
@@ -1638,7 +1818,7 @@ class SweepService:
         reason: str,
         pinned_start: Optional[int] = None,
         front: bool = False,
-    ) -> PendingTrial:
+    ) -> list:
         """The first-class preemption primitive (defrag's move, the
         deadline eviction and the graceful drain share it), in two
         phases (docs/RESILIENCE.md "Snapshot-fast drain"):
@@ -1663,7 +1843,19 @@ class SweepService:
 
         ``snapshot_drain=False`` (the bench's v1 comparison arm) keeps
         the legacy behavior: join the write inline, ledger, requeue —
-        the full-persist drain the artifact measures against."""
+        the full-persist drain the artifact measures against.
+
+        Returns the requeued entries: ONE for a classic or pipelined
+        placement (a pipelined vector drains all-or-nothing through
+        its single entry — every stage block frees, the re-place
+        scan-restores each stage), K for a stacked bucket (all live
+        lanes snapshot together via :meth:`_drain_stacked` and requeue
+        as classic singles — the stacked/classic bit-parity contract
+        makes the resume exact)."""
+        if ap.stacked:
+            return self._drain_stacked(
+                ap, reason=reason, front=front
+            )
         entry = next(iter(ap.entries.values()))
         tid = entry.trial_id
         t0 = time.perf_counter()
@@ -1704,7 +1896,7 @@ class SweepService:
                 pinned_start=pinned_start,
                 front=front,
             )
-            return entry
+            return [entry]
         # Legacy full-persist drain: everything on the caller's clock.
         try:
             ap.run._join_ckpt()
@@ -1738,7 +1930,109 @@ class SweepService:
             pinned_start=pinned_start,
             front=front,
         )
-        return entry
+        return [entry]
+
+    def _drain_stacked(
+        self, ap: _Active, *, reason: str, front: bool = False
+    ) -> list:
+        """Drain a whole stacked bucket: already-finished lanes settle,
+        every LIVE lane's state is fetched device→host at its current
+        epoch boundary in one pass (``_StackedBucketRun.
+        drain_snapshot`` — the PR 15 snapshot path) and requeued as a
+        classic single, which scan-restores the lane checkpoint
+        bit-identically (the stacked/classic parity contract). Under
+        the snapshot-fast drain the K persists land on the bucket's
+        background writer — one :class:`_PendingPersist` per lane, all
+        sharing the writer's idle flag."""
+        t0 = time.perf_counter()
+        # Drive the bucket to a ROUND BOUNDARY before snapshotting: the
+        # stacked runner yields mid-round (mid-epoch lane states), and
+        # the classic resume only restores at epoch boundaries — a
+        # mid-epoch snapshot would either be rejected (strict step
+        # skew) or replay applied batches. request_drain() arms the
+        # cooperative seam; pumping to StopIteration finishes the
+        # in-flight round (at most one epoch of extra compute — the
+        # honest cost of moving a stacked bucket).
+        pump_failed = False
+        try:
+            ap.run.request_drain()
+            while True:
+                next(ap.gen)
+        except StopIteration:
+            pass
+        except Exception:  # noqa: BLE001 — drain must go on
+            pump_failed = True
+        try:
+            ap.gen.close()
+        except Exception:  # noqa: BLE001 — teardown must go on
+            pass
+        results = ap.run.results
+        live: list = []
+        for tid, entry in list(ap.entries.items()):
+            r = results.get(tid)
+            if r is not None and r.status in SETTLED_STATUSES:
+                self._settle(entry, status=r.status, error=r.error)
+            else:
+                live.append((tid, entry))
+        progress = {
+            tid: self._attempt_progress(ap, tid) for tid, _ in live
+        }
+        if not pump_failed:
+            ap.run.drain_snapshot([tid for tid, _ in live], reason=reason)
+        else:
+            # Mid-round states are not resumable; the lanes fall back
+            # to their last durable lane checkpoint on requeue.
+            reason = f"{reason} (drain pump failed; last durable ckpt)"
+        self._retire(ap)
+        snap_s = time.perf_counter() - t0
+        requeued = []
+        for tid, entry in live:
+            self.drain_snapshot.observe(snap_s, exemplar=entry.sub_id)
+            _emit(
+                "ckpt_snapshot",
+                trial_id=tid,
+                sub_id=entry.sub_id,
+                tenant=entry.tenant,
+                wall_s=round(snap_s, 6),
+                drain=True,
+                stacked=True,
+                reason=reason,
+                persist_in_flight=not ap.run._ckpt_idle(),
+            )
+            if self.snapshot_drain:
+                self._pending_persists.append(
+                    _PendingPersist(
+                        ap=ap,
+                        entry=entry,
+                        reason=reason,
+                        progress=progress[tid],
+                        chash=self.chashes.get(tid, ""),
+                        attempt=self.attempts.get(tid, 1),
+                        t0=t0,
+                        snapshot_s=snap_s,
+                    )
+                )
+            self._requeue(entry, reason=reason, front=front)
+            requeued.append(entry)
+        if not self.snapshot_drain and live:
+            try:
+                ap.run._join_ckpt()
+            except Exception:  # noqa: BLE001
+                pass
+            persist_s = time.perf_counter() - t0
+            for tid, entry in live:
+                self.drain_persist.observe(
+                    persist_s, exemplar=entry.sub_id
+                )
+                self.ledger.attempt_end(
+                    tid,
+                    self.chashes.get(tid, ""),
+                    self.attempts.get(tid, 1),
+                    "preempted",
+                    error=reason,
+                    summary=progress[tid],
+                )
+        return requeued
 
     def _poll_persists(self, now: float) -> bool:
         """Land snapshot-drained victims' deferred bookkeeping once
@@ -1851,11 +2145,10 @@ class SweepService:
         blocks = None
         blocked_emitted = False
         for starved in self.sched.deadline_pending(now=now):
-            if starved.sizes is not None:
-                # Vector (pipelined) deadline requests place through
-                # normal EDF order only — evicting several windows at
-                # once is more churn than the budget is worth.
-                continue
+            # Vector (pipelined) deadline requests preempt for their
+            # TOTAL: a contiguous window of sum(sizes) slices hosts
+            # every stage block (the allocator carves first-fit inside
+            # it), so one eviction plan serves the whole vector.
             if starved.not_before > now:
                 continue  # backing off — its own retry clock rules
             if starved.deadline_ts - now > self.preempt.urgency_s:
@@ -1912,27 +2205,28 @@ class SweepService:
                 # lands, and resume from their drained checkpoint —
                 # or the RAM snapshot, same-process — on their next
                 # placement.
-                entry = self._checkpoint_drain(
+                entries = self._checkpoint_drain(
                     ap,
                     reason=(
                         f"deadline preemption for {starved.sub_id}"
                     ),
                 )
-                entry.preempt_count += 1
-                self.preempt.note_eviction(entry.trial_id, now)
+                for entry in entries:
+                    entry.preempt_count += 1
+                    self.preempt.note_eviction(entry.trial_id, now)
+                    _emit(
+                        "preempt_victim",
+                        trial_id=entry.trial_id,
+                        sub_id=entry.sub_id,
+                        tenant=entry.tenant,
+                        start=ap.start,
+                        size=ap.size,
+                        preempt_count=entry.preempt_count,
+                        for_sub_id=starved.sub_id,
+                    )
                 self._preempt_evictions += 1
                 self._preempt_evicted_slices += ap.size
                 evicted += ap.size
-                _emit(
-                    "preempt_victim",
-                    trial_id=entry.trial_id,
-                    sub_id=entry.sub_id,
-                    tenant=entry.tenant,
-                    start=ap.start,
-                    size=ap.size,
-                    preempt_count=entry.preempt_count,
-                    for_sub_id=starved.sub_id,
-                )
             self._preempt_events += 1
             self._preempt_targets.add(starved.sub_id)
             self.preempt.last_event_ts = now

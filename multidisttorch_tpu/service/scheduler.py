@@ -495,6 +495,28 @@ class FairShareScheduler:
                 out.extend(lanes[pri])
         return out
 
+    def take(self, sub_id: str) -> Optional[PendingTrial]:
+        """Remove and return the queued entry for ``sub_id``, or None.
+
+        The transfer primitive's scheduler half (shard split handoffs
+        and cross-shard steal grants take queued-but-unplaced work out
+        of this shard's queues before re-spooling it at the
+        destination). No virtual-time refund: :meth:`_charge` advances
+        vtime only at PLACEMENT, and a never-placed entry was never
+        charged — the origin tenant's attained service is exactly what
+        it attained. The emptied (tenant, lane) list is pruned so
+        ``pending_count`` (admission's idle-activation check) sees a
+        truly idle tenant."""
+        for tenant, lanes in self._pending.items():
+            for pri, q in lanes.items():
+                for i, e in enumerate(q):
+                    if e.sub_id == sub_id:
+                        q.pop(i)
+                        if not q:
+                            del lanes[pri]
+                        return e
+        return None
+
     # -- the DRR pass -------------------------------------------------
 
     def _lanes_present(self) -> list[int]:

@@ -910,9 +910,18 @@ class TestServiceRuntime:
         assert not ap.movable()  # progress but nothing durable
         ap.run._step_no = 0
         assert ap.movable()  # nothing to lose
-        # Stacked placements are never movable.
+        # Stacked placements are movable now: the bucket drain
+        # snapshots every live lane at its epoch boundary itself, so
+        # only an in-flight lane persist defers them — and only under
+        # the legacy join-drain (the snapshot drain adopts the write).
         ap.stacked = True
-        assert not ap.movable()
+        assert ap.movable()
+        ap.run._ckpt_thread = threading.Thread(
+            target=time.sleep, args=(30,), daemon=True
+        )
+        ap.run._ckpt_thread.start()
+        assert not ap.movable()  # legacy join-drain defers
+        assert ap.movable(snapshot_drain=True)  # adopted in-flight write
 
 
 # --------------------------------------------------------------------

@@ -1,0 +1,387 @@
+"""Elastic shard topology invariants (ISSUE 17, docs/SERVICE.md
+"Shard topology"): the extendible-hashing routing trie, the
+epoch-versioned topology log (first-writer-wins appends, strict epoch
+increase, torn-tail replay), the split/merge event protocol (pending
+splits route nowhere until commit; aborted child ids are burned), the
+exactly-one-owner property under ANY split/merge sequence, the
+client's bounded wrong-shard retry, and the dynamic-topology loadgen
+scenario zoo."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from multidisttorch_tpu.service import fabric, queue as squeue
+from multidisttorch_tpu.service import topology as stopo
+
+pytestmark = pytest.mark.fabric
+
+
+# -- identity + routing ----------------------------------------------
+
+
+def test_identity_topology_matches_static_routing():
+    """An empty log folds to the identity topology: routing is
+    byte-identical to the static CRC ``shard_of`` — a PR 12-era fabric
+    directory keeps working unchanged."""
+    for n in (1, 2, 3, 8):
+        topo = stopo.Topology(n)
+        assert topo.epoch == 0
+        assert topo.live_shards() == list(range(n))
+        for i in range(64):
+            t = f"tenant-{i}"
+            assert topo.route(t) == fabric.shard_of(t, n)
+
+
+def test_load_topology_missing_log_is_identity(tmp_path):
+    d = str(tmp_path)
+    fabric.ensure_fabric_config(d, 3)
+    topo = stopo.load_topology(d)
+    assert topo.epoch == 0 and topo.live_shards() == [0, 1, 2]
+
+
+# -- the exactly-one-owner property ----------------------------------
+
+
+def _assert_exactly_one_owner(topo: stopo.Topology, tenants) -> None:
+    """Every tenant routes to exactly one LIVE shard, and exactly one
+    leaf of the trie matches its hash (the partition invariant the
+    deepest-match walk relies on)."""
+    live = set(topo.live_shards())
+    for t in tenants:
+        h = stopo.tenant_hash(t)
+        owner = topo.route(t)
+        assert owner in live
+        matches = [
+            leaf
+            for leaf in topo.leaves.values()
+            if leaf.matches(h, topo.n_base)
+        ]
+        assert len(matches) == 1, (t, matches, topo.describe())
+        assert matches[0].shard == owner
+
+
+def _mergeable_pairs(topo: stopo.Topology):
+    """(parent, child) leaf pairs the MERGE event would accept."""
+    out = []
+    for p, pl in topo.leaves.items():
+        if pl.depth < 1 or pl.bits & (1 << (pl.depth - 1)):
+            continue
+        for c, cl in topo.leaves.items():
+            if (
+                c != p
+                and cl.base == pl.base
+                and cl.depth == pl.depth
+                and cl.bits == (pl.bits | (1 << (pl.depth - 1)))
+            ):
+                out.append((p, c))
+    return sorted(out)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("n_base", [1, 2, 3])
+def test_any_split_merge_sequence_keeps_one_owner(seed, n_base):
+    """The property test: for ANY tenant set and ANY randomized
+    split/merge sequence — begins, commits, aborts, merges — every
+    tenant routes to exactly one live shard at EVERY epoch, including
+    mid-split (a pending child is not routable until its commit)."""
+    rng = np.random.default_rng(np.random.SeedSequence([seed, n_base]))
+    topo = stopo.Topology(n_base)
+    tenants = [f"t{seed}-{i}" for i in range(150)]
+    _assert_exactly_one_owner(topo, tenants)
+
+    applied = {"n": 0}
+
+    def apply(event, parent, child):
+        ok = topo.apply(
+            {
+                "event": event,
+                "parent": parent,
+                "child": child,
+                "epoch": topo.epoch + 1,
+            }
+        )
+        assert ok, (event, parent, child, topo.describe())
+        applied["n"] += 1
+
+    for _ in range(40):
+        merges = _mergeable_pairs(topo)
+        if merges and rng.random() < 0.3:
+            p, c = merges[int(rng.integers(0, len(merges)))]
+            apply(stopo.MERGE, p, c)
+        else:
+            live = topo.live_shards()
+            parent = int(live[int(rng.integers(0, len(live)))])
+            child = topo.next_shard_id()
+            before = {t: topo.route(t) for t in tenants}
+            apply(stopo.SPLIT_BEGIN, parent, child)
+            # Mid-split: routing is UNCHANGED — the pending child owns
+            # nothing until the commit lands.
+            assert child not in topo.live_shards()
+            assert {t: topo.route(t) for t in tenants} == before
+            _assert_exactly_one_owner(topo, tenants)
+            if rng.random() < 0.25:
+                apply(stopo.SPLIT_ABORT, parent, child)
+                assert {t: topo.route(t) for t in tenants} == before
+            else:
+                apply(stopo.SPLIT_COMMIT, parent, child)
+                # The split partitions the parent's old range: every
+                # tenant it owned now routes to parent XOR child.
+                for t, old in before.items():
+                    if old == parent:
+                        assert topo.route(t) in (parent, child)
+                    else:
+                        assert topo.route(t) == old
+        _assert_exactly_one_owner(topo, tenants)
+    # The walk's epochs were strictly increasing by construction; the
+    # fold must agree.
+    assert topo.epoch == applied["n"]
+
+
+def test_aborted_child_id_is_burned():
+    topo = stopo.Topology(2)
+    child = topo.next_shard_id()
+    assert child == 2
+    topo.apply(
+        {"event": stopo.SPLIT_BEGIN, "parent": 0, "child": 2, "epoch": 1}
+    )
+    topo.apply(
+        {"event": stopo.SPLIT_ABORT, "parent": 0, "child": 2, "epoch": 2}
+    )
+    assert topo.live_shards() == [0, 1]
+    # A stale replica's references to shard 2 can never alias a new
+    # shard: the id is never recycled.
+    assert topo.next_shard_id() == 3
+
+
+def test_epoch_must_strictly_increase():
+    topo = stopo.Topology(2)
+    ev = {"event": stopo.SPLIT_BEGIN, "parent": 0, "child": 2, "epoch": 1}
+    assert topo.apply(ev)
+    # Replays and epoch races are ignored, not applied twice.
+    assert not topo.apply(ev)
+    assert not topo.apply({**ev, "child": 3})
+    assert topo.epoch == 1 and len(topo.pending) == 1
+
+
+def test_merge_rejects_non_siblings():
+    topo = stopo.Topology(2)
+    for e, ev in enumerate(
+        (
+            {"event": stopo.SPLIT_BEGIN, "parent": 0, "child": 2},
+            {"event": stopo.SPLIT_COMMIT, "parent": 0, "child": 2},
+            {"event": stopo.SPLIT_BEGIN, "parent": 1, "child": 3},
+            {"event": stopo.SPLIT_COMMIT, "parent": 1, "child": 3},
+        )
+    ):
+        assert topo.apply({**ev, "epoch": e + 1})
+    # Different base cells: never siblings.
+    assert not topo.apply(
+        {"event": stopo.MERGE, "parent": 0, "child": 3, "epoch": 5}
+    )
+    # True siblings merge; the child leaf dies, the parent widens.
+    assert topo.apply(
+        {"event": stopo.MERGE, "parent": 0, "child": 2, "epoch": 5}
+    )
+    assert topo.live_shards() == [0, 1, 3]
+    assert topo.leaves[0].depth == 0
+
+
+# -- the durable log --------------------------------------------------
+
+
+def test_append_topology_event_epochs_and_fold(tmp_path):
+    d = str(tmp_path)
+    fabric.ensure_fabric_config(d, 2)
+    won, epoch, topo = stopo.append_topology_event(
+        d, {"event": stopo.SPLIT_BEGIN, "parent": 0, "child": 2}
+    )
+    assert won and epoch == 1
+    won, epoch, topo = stopo.append_topology_event(
+        d, {"event": stopo.SPLIT_COMMIT, "parent": 0, "child": 2}
+    )
+    assert won and epoch == 2
+    assert topo.live_shards() == [0, 1, 2]
+    assert stopo.load_topology(d).epoch == 2
+
+
+def test_append_topology_event_lost_race(tmp_path, monkeypatch):
+    """A replica whose pre-append read missed a rival's record picks
+    the SAME epoch; the read-back sees the rival's line first and
+    reports the race lost — the fold ignores the loser entirely."""
+    d = str(tmp_path)
+    fabric.ensure_fabric_config(d, 2)
+    stopo.append_topology_event(
+        d, {"event": stopo.SPLIT_BEGIN, "parent": 0, "child": 2}
+    )
+    real = stopo.load_topology_events
+    calls = {"n": 0}
+
+    def stale_first_read(service_dir):
+        evs = real(service_dir)
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return evs[:-1]  # the rival's append isn't visible yet
+        return evs
+
+    monkeypatch.setattr(stopo, "load_topology_events", stale_first_read)
+    won, epoch, topo = stopo.append_topology_event(
+        d, {"event": stopo.SPLIT_BEGIN, "parent": 1, "child": 2}
+    )
+    assert not won and epoch == 1
+    # The loser's record is in the file but no fold ever applies it.
+    assert topo.pending_for(0) is not None
+    assert topo.pending_for(1) is None
+
+
+def test_torn_topology_log_tail_replay(tmp_path):
+    """Crash mid-append: a torn final line (no newline, half a JSON
+    object) and binary junk are skipped; every complete record before
+    them folds — the queue journal's read contract."""
+    d = str(tmp_path)
+    fabric.ensure_fabric_config(d, 2)
+    stopo.append_topology_event(
+        d, {"event": stopo.SPLIT_BEGIN, "parent": 0, "child": 2}
+    )
+    stopo.append_topology_event(
+        d, {"event": stopo.SPLIT_COMMIT, "parent": 0, "child": 2}
+    )
+    path = stopo.topology_path(d)
+    with open(path, "a") as f:
+        f.write("[1, 2, 3]\n")  # decodable but not a record: skipped
+        f.write('{"event": "split_begin", "parent": 1, "ch')  # torn
+    topo = stopo.load_topology(d)
+    assert topo.epoch == 2
+    assert topo.live_shards() == [0, 1, 2]
+    assert not topo.pending
+    # The NEXT append lands after the torn tail as its own complete
+    # line and still folds (O_APPEND starts a fresh line boundary is
+    # NOT guaranteed — the reader just skips the merged garbage line).
+    won, epoch, topo2 = stopo.append_topology_event(
+        d, {"event": stopo.SPLIT_BEGIN, "parent": 1, "child": 3}
+    )
+    assert won and epoch == 3
+    assert topo2.pending_for(1) is not None
+
+
+# -- client wrong-shard retry ----------------------------------------
+
+
+def _tenant_routing_to(shard: int, n: int = 2) -> str:
+    i = 0
+    while True:
+        t = f"wst{i}"
+        if stopo.tenant_hash(t) % n == shard:
+            return t
+        i += 1
+
+
+def test_client_wrong_shard_retry_bounded(tmp_path):
+    """A ``rejected_wrong_shard`` verdict makes the client re-read the
+    topology and resubmit the SAME id to the current owner — exactly
+    once. The origin's rejection is superseded, not terminal; a
+    second rejection AT THE RETRY DESTINATION is terminal (the
+    one-retry bound)."""
+    d = str(tmp_path)
+    fabric.ensure_fabric_config(d, 2)
+    tenant = _tenant_routing_to(1)
+    sh1 = fabric.shard_dir(d, 1)
+
+    # The tenant's submission landed on shard 0 (stale client) and the
+    # shard-0 daemon journaled the wrong-shard rejection.
+    sh0 = fabric.shard_dir(d, 0)
+    c0 = squeue.SweepClient(sh0, tenant=tenant)
+    sid = c0.submit({"hidden_dim": 16}, tenant=tenant)
+    q0 = squeue.SubmissionQueue(sh0)
+    drained = q0.drain_intake(known_ids=set())
+    assert [s.submission_id for s in drained] == [sid]
+    q0.rejected(
+        sid,
+        verdict=squeue.REJECT_WRONG_SHARD,
+        reason="tenant routes to shard 1",
+    )
+
+    client = fabric.FabricClient(d, n_shards=2, tenant=tenant)
+    folded = client._folds()
+    assert folded[sid]["state"] == squeue.REJECTED
+    assert client._retry_wrong_shard(folded) is True
+    # One resubmit, spooled to the owner, same id.
+    spool = os.path.join(squeue.intake_dir(sh1), sid + ".json")
+    assert os.path.exists(spool)
+    assert client._wrong_shard_retries[sid] == 1
+    with open(spool) as f:
+        assert json.load(f)["submission_id"] == sid
+
+    # Bounded: another poll resubmits nothing.
+    before = os.path.getmtime(spool)
+    assert client._retry_wrong_shard(client._folds()) is False
+    assert os.path.getmtime(spool) == before
+
+    # The origin's stale rejection is NOT terminal while the retry is
+    # in flight...
+    folded = client._folds()
+    assert folded[sid]["shard"] == 0
+    assert not client._terminal(sid, folded[sid])
+    # ...but a wrong-shard rejection at the retry destination is.
+    q1 = squeue.SubmissionQueue(sh1)
+    q1.drain_intake(known_ids=set())
+    q1.rejected(
+        sid, verdict=squeue.REJECT_WRONG_SHARD, reason="still wrong"
+    )
+    folded = client._folds()
+    assert folded[sid]["shard"] == 1
+    assert client._terminal(sid, folded[sid])
+
+
+# -- dynamic-topology loadgen scenarios ------------------------------
+
+
+def test_fabric_scenario_zoo_gates():
+    """Both named scenarios replay a small seeded workload through the
+    two-arm harness: the elastic arm actually splits/steals, both arms
+    settle everything (zero lost, none double-owned), and the elastic
+    arm holds the within-10%-of-static latency/deadline gates."""
+    from multidisttorch_tpu.service.loadgen import (
+        FABRIC_SCENARIOS,
+        run_fabric_scenario,
+    )
+
+    assert set(FABRIC_SCENARIOS) == {"coordinated_burst", "split_storm"}
+    for name in sorted(FABRIC_SCENARIOS):
+        r = run_fabric_scenario(name, n_submissions=1500, seed=3)
+        assert r["protocol"] == "fabric_loadgen_v1"
+        assert r["scenario"] == name
+        dyn, sta = r["dynamic"], r["static"]
+        assert dyn["splits"] >= 1, name
+        assert sta["splits"] == 0 and sta["steals"] == 0
+        assert dyn["topology_epoch"] == 2 * dyn["splits"]
+        assert len(dyn["final_shards"]) == 2 + dyn["splits"]
+        for arm in (dyn, sta):
+            assert arm["zero_lost"], (name, arm["unfinished"])
+            assert arm["no_double_own"]
+            assert arm["completed"] == arm["admitted"]
+        assert all(r["gates"].values()), (name, r["gates"])
+    with pytest.raises(ValueError):
+        run_fabric_scenario("nope")
+
+
+def test_fabric_scenario_seeded_reruns_identical():
+    from multidisttorch_tpu.service.loadgen import run_fabric_scenario
+
+    def strip_wall(rep):
+        return {
+            k: (
+                {kk: vv for kk, vv in v.items() if kk != "wall_s"}
+                if k in ("dynamic", "static")
+                else v
+            )
+            for k, v in rep.items()
+        }
+
+    a = run_fabric_scenario("coordinated_burst", n_submissions=600, seed=7)
+    b = run_fabric_scenario("coordinated_burst", n_submissions=600, seed=7)
+    assert strip_wall(a) == strip_wall(b)

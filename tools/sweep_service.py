@@ -106,6 +106,19 @@ def main(argv=None) -> int:
     parser.add_argument("--lease-deadline", type=float, default=3.0,
                         help="seconds without a lease renewal before a "
                         "shard counts orphaned and is adopted")
+    parser.add_argument("--split-queue-depth", type=int, default=None,
+                        help="fabric: split an owned shard whose "
+                        "pending queue reaches this depth (default: "
+                        "splits off — static topology)")
+    parser.add_argument("--split-min-interval", type=float, default=2.0,
+                        help="fabric: seconds between split attempts "
+                        "by this replica")
+    parser.add_argument("--steal-threshold", type=int, default=None,
+                        help="fabric: steal queued work for an idle "
+                        "owned shard from a peer shard whose backlog "
+                        "reaches this depth (default: stealing off)")
+    parser.add_argument("--steal-batch", type=int, default=2,
+                        help="fabric: max submissions per steal grant")
     parser.add_argument("--fault-plan", default=None,
                         help="arm a FaultPlan JSON against this "
                         "replica's dispatch clock (daemon_lost etc.; "
@@ -208,6 +221,10 @@ def main(argv=None) -> int:
             n_shards=args.n_shards,
             lease_deadline_s=args.lease_deadline,
             injector=injector,
+            split_queue_depth=args.split_queue_depth,
+            split_min_interval_s=args.split_min_interval,
+            steal_threshold=args.steal_threshold,
+            steal_batch=args.steal_batch,
             **svc_kwargs,
         )
     else:
