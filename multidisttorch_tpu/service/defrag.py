@@ -46,6 +46,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from multidisttorch_tpu.service.scheduler import SlicePool
+from multidisttorch_tpu.telemetry import ctlprof as _ctlprof
 
 
 @dataclass(frozen=True)
@@ -107,14 +108,46 @@ def plan_defrag(
     ``movable_fn`` overrides/bolsters each block's own ``movable`` flag
     (the runtime passes a live checkpoint-flushed check so the verdict
     is taken at PLAN time, not placement time)."""
+    prof = _ctlprof.get_ctlprof()
+    if prof is None:
+        return _plan_defrag(
+            pool, placements, want_size, movable_fn=movable_fn
+        )[0]
+    _t = prof.t0()
+    plan, probes = _plan_defrag(
+        pool, placements, want_size, movable_fn=movable_fn
+    )
+    # examined = slice probes across every candidate window (the
+    # O(n_slices * windows) scan the rebuild must make incremental);
+    # mutated = moves actually planned.
+    prof.note(
+        "defrag_plan", _t,
+        examined=probes,
+        mutated=len(plan.moves) if plan is not None else 0,
+    )
+    return plan
+
+
+def _plan_defrag(
+    pool: SlicePool,
+    placements: list[PlacedBlock],
+    want_size: int,
+    *,
+    movable_fn: Optional[Callable[[PlacedBlock], bool]] = None,
+) -> tuple[Optional[DefragPlan], int]:
+    """``(plan, slice probes)`` — see :func:`plan_defrag`."""
+    probes = 0
     n = pool.n_slices
     if want_size < 1 or want_size > n:
-        return None
+        return None, probes
     if pool.largest_free_run() >= want_size:
         # Nothing to do: a zero-move plan naming the already-free block.
         for start, ln in pool.free_runs():
             if ln >= want_size:
-                return DefragPlan(window_start=start, window_size=want_size)
+                return (
+                    DefragPlan(window_start=start, window_size=want_size),
+                    probes,
+                )
     by_slice: dict[int, PlacedBlock] = {}
     blocks_of: dict[int, list[PlacedBlock]] = {}
     for p in placements:
@@ -135,6 +168,7 @@ def plan_defrag(
         victims: dict[int, PlacedBlock] = {}
         ok = True
         for i in window:
+            probes += 1
             if i in free:
                 continue
             p = by_slice.get(i)
@@ -188,7 +222,7 @@ def plan_defrag(
                     window_start=w0, window_size=want_size, moves=moves
                 ),
             )
-    return best[2] if best is not None else None
+    return (best[2] if best is not None else None), probes
 
 
 @dataclass
@@ -220,13 +254,36 @@ def plan_preemption(
     feasibility leg: eviction frees the victim's slices outright, so
     the only cost is the victims' lost progress, minimized as total
     evicted slice-size (ties: lowest window start)."""
+    prof = _ctlprof.get_ctlprof()
+    if prof is None:
+        return _plan_preemption(pool, placements, want_size)[0]
+    _t = prof.t0()
+    plan, probes = _plan_preemption(pool, placements, want_size)
+    prof.note(
+        "preempt_window", _t,
+        examined=probes,
+        mutated=len(plan.victims) if plan is not None else 0,
+    )
+    return plan
+
+
+def _plan_preemption(
+    pool: SlicePool,
+    placements: list[PlacedBlock],
+    want_size: int,
+) -> tuple[Optional[PreemptPlan], int]:
+    """``(plan, slice probes)`` — see :func:`plan_preemption`."""
+    probes = 0
     n = pool.n_slices
     if want_size < 1 or want_size > n:
-        return None
+        return None, probes
     if pool.largest_free_run() >= want_size:
         for start, ln in pool.free_runs():
             if ln >= want_size:
-                return PreemptPlan(window_start=start, window_size=want_size)
+                return (
+                    PreemptPlan(window_start=start, window_size=want_size),
+                    probes,
+                )
     by_slice: dict[int, PlacedBlock] = {}
     blocks_of: dict[int, list[PlacedBlock]] = {}
     for p in placements:
@@ -241,6 +298,7 @@ def plan_preemption(
         victims: dict[int, PlacedBlock] = {}
         ok = True
         for i in range(w0, w0 + want_size):
+            probes += 1
             if i in free:
                 continue
             p = by_slice.get(i)
@@ -263,7 +321,7 @@ def plan_preemption(
                 victims=sorted(victims),
                 victim_slices=cost,
             )
-    return best
+    return best, probes
 
 
 def _runs_of(slices: list[int]) -> list[list[int]]:

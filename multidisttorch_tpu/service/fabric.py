@@ -66,6 +66,7 @@ from typing import Optional
 from multidisttorch_tpu.parallel.membership import latest_lease, read_lease
 from multidisttorch_tpu.service import queue as squeue
 from multidisttorch_tpu.service import topology as stopo
+from multidisttorch_tpu.telemetry import ctlprof as _ctlprof
 
 FABRIC_DIRNAME = "fabric"
 SHARDS_DIRNAME = "shards"
@@ -1247,10 +1248,17 @@ class FabricReplica:
         fence = self.fences.get(shard)
         if svc is None or fence is None:
             return
+        prof = _ctlprof.get_ctlprof()
+        if prof is not None:
+            _t = prof.t0()
         path = steal_file(self.service_dir, shard)
         recs = _read_jsonl(path)
         if not recs:
+            if prof is not None:
+                prof.note("steal_grant", _t)
             return
+        scanned = len(recs)
+        granted = 0
         answered = {r.get("seq") for r in recs if r.get("kind") == "grant"}
         for r in recs:
             if r.get("kind") != "request" or r.get("seq") in answered:
@@ -1261,6 +1269,7 @@ class FabricReplica:
                 # Steal from the queue's TAIL (newest first): the
                 # oldest entries are closest to placement here.
                 for e in reversed(svc.sched.pending_entries()):
+                    scanned += 1
                     if e.resume_scan or e.pinned_start is not None:
                         continue
                     sub_ids.append(e.sub_id)
@@ -1296,6 +1305,11 @@ class FabricReplica:
                     sub_ids=sub_ids,
                 )
                 self.steals_granted += len(moved)
+                granted += len(moved)
+        if prof is not None:
+            # examined = steal-file records + queue entries scanned for
+            # grantable work; mutated = submissions actually moved.
+            prof.note("steal_grant", _t, examined=scanned, mutated=granted)
 
     def _execute_grant(
         self, shard: int, svc, *, thief_shard: int, sub_ids: list

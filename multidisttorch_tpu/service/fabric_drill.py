@@ -623,8 +623,20 @@ def run_deadline_phase(work_dir: str) -> dict:
 
 def run_loadgen_phase(n_submissions: int, *, seed: int = 0) -> dict:
     from multidisttorch_tpu.service.loadgen import run_loadgen
+    from multidisttorch_tpu.telemetry import ctlprof as _ctlprof
 
-    report = run_loadgen(n_submissions=n_submissions, seed=seed)
+    # The replay runs under the control-plane profiler (armed for the
+    # phase if nothing armed one already): the banked report carries
+    # per-phase flight books alongside submissions/s, so the
+    # ctlprof ledger's baseline rounds come from THIS path.
+    own = _ctlprof.get_ctlprof() is None
+    prof = _ctlprof.configure() if own else _ctlprof.get_ctlprof()
+    try:
+        report = run_loadgen(n_submissions=n_submissions, seed=seed)
+        report["ctl"] = prof.books()
+    finally:
+        if own:
+            _ctlprof.disable()
     report["gates"] = {
         "zero_lost": report["zero_lost"],
         "fairness_within_10pct": report["fairness"]["within_10pct"],

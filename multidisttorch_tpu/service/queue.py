@@ -36,6 +36,8 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Optional
 
+from multidisttorch_tpu.telemetry import ctlprof as _ctlprof
+
 QUEUE_NAME = "queue.jsonl"
 INTAKE_DIR = "intake"
 
@@ -424,11 +426,18 @@ class SubmissionQueue:
         its submission never committed). Returns the newly accepted
         submissions in spool-name order (deterministic across
         restarts)."""
+        prof = _ctlprof.get_ctlprof()
+        if prof is not None:
+            _t = prof.t0()
         d = intake_dir(self.service_dir)
         if not os.path.isdir(d):
+            if prof is not None:
+                prof.note("intake_drain", _t)
             return []
         fresh: list[Submission] = []
+        seen = 0
         for name in sorted(os.listdir(d)):
+            seen += 1
             if not name.endswith(".json"):
                 continue  # .tmp = a client mid-write (or dead mid-write)
             p = os.path.join(d, name)
@@ -446,6 +455,10 @@ class SubmissionQueue:
                 os.unlink(p)  # AFTER the durable append — replay-safe
             except OSError:
                 pass
+        if prof is not None:
+            # examined = spool entries iterated (torn/.tmp included);
+            # mutated = submissions journaled fresh.
+            prof.note("intake_drain", _t, examined=seen, mutated=len(fresh))
         return fresh
 
     # -- state transitions -------------------------------------------

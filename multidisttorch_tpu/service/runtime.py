@@ -75,6 +75,7 @@ from multidisttorch_tpu.service.scheduler import (
     SlicePool,
     TenantPolicy,
 )
+from multidisttorch_tpu.telemetry import ctlprof as _ctlprof
 from multidisttorch_tpu.telemetry import trace as ttrace
 from multidisttorch_tpu.utils.logging import log0
 
@@ -930,9 +931,18 @@ class SweepService:
         replay); a crash after (2) leaves a terminal ``moved`` record
         recovery skips. ``on_moved(sub_id)`` fires after each journal
         append — the chaos drill's kill-mid-split seam."""
+        prof = _ctlprof.get_ctlprof()
+        # Steal-kind transfers run inside the caller's ``steal_grant``
+        # window; only split handoffs get their own phase (the
+        # taxonomy's "topology route + split handoff" half).
+        track = prof is not None and kind == "split"
+        if track:
+            _t = prof.t0()
         self._advance_folds()
+        examined = 0
         moved: list[str] = []
         for entry in list(self.sched.pending_entries()):
+            examined += 1
             if max_n is not None and len(moved) >= max_n:
                 break
             if entry.resume_scan or entry.pinned_start is not None:
@@ -985,6 +995,10 @@ class SweepService:
             )
             if on_moved is not None:
                 on_moved(entry.sub_id)
+        if track:
+            prof.note(
+                "split_handoff", _t, examined=examined, mutated=len(moved)
+            )
         return moved
 
     # -- per-submission datasets -------------------------------------
@@ -2298,6 +2312,9 @@ class SweepService:
         persistent folds. A file shorter than its offset means a
         rewrite under us (e.g. the supervisor compacted the ledger
         between worlds) — reset that fold and start over."""
+        prof = _ctlprof.get_ctlprof()
+        if prof is not None:
+            _t = prof.t0()
         try:
             if os.path.getsize(self.queue.path) < self._qoffset:
                 self._qfold.clear()
@@ -2315,6 +2332,9 @@ class SweepService:
             for rec in self._qfold.values():
                 if rec["state"] in (squeue.SETTLED, squeue.REJECTED):
                     rec.pop("config", None)
+        if prof is not None:
+            prof.note("journal_fold", _t, examined=len(recs), mutated=len(recs))
+            _t = prof.t0()
         try:
             if os.path.getsize(self.ledger.path) < self._led_offset:
                 self._tenant_fold.clear()
@@ -2328,6 +2348,8 @@ class SweepService:
         fold_tenant_goodput_into(
             self._tenant_fold, self._tenant_covered, recs
         )
+        if prof is not None:
+            prof.note("ledger_fold", _t, examined=len(recs), mutated=len(recs))
 
     def _ckpt_books(self) -> dict:
         """The checkpoint data plane's service books: drain-phase
@@ -2418,6 +2440,16 @@ class SweepService:
                 },
             },
             "checkpoint": self._ckpt_books(),
+            # Control-plane flight books (telemetry/ctlprof.py): live
+            # per-phase p50/p95/p99 with bucket-error bounds, passes/s,
+            # scan efficiency, worst-pass capture. {"enabled": False}
+            # when the profiler is off — the block is always present so
+            # sweep_top's panel can say WHY it's empty.
+            "ctl": (
+                _ctlprof.get_ctlprof().books()
+                if _ctlprof.get_ctlprof() is not None
+                else {"enabled": False}
+            ),
             "deadline": {
                 "hits": self._deadline_hits,
                 "misses": self._deadline_misses,
@@ -2436,11 +2468,16 @@ class SweepService:
         }
 
     def write_books(self) -> str:
+        prof = _ctlprof.get_ctlprof()
+        if prof is not None:
+            _t = prof.t0()
         path = os.path.join(self.service_dir, BOOKS_NAME)
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(self.books(), f, indent=2, default=str)
         os.replace(tmp, path)
+        if prof is not None:
+            prof.note("books_write", _t, examined=1, mutated=1)
         return path
 
     # -- the loop -----------------------------------------------------
@@ -2449,6 +2486,12 @@ class SweepService:
         """One service cycle; returns whether anything progressed (the
         caller's idle-sleep signal). Factored out of :meth:`serve` so
         tests can single-step the daemon deterministically."""
+        prof = _ctlprof.get_ctlprof()
+        if prof is not None:
+            # One tick = one control-plane pass: the phase notes below
+            # (and inside schedule/drain/fold/planner calls) land in
+            # this pass's flight book.
+            prof.pass_begin()
         now = time.time()
         if self._fence is not None:
             # One fence check per tick, BEFORE any placement or
@@ -2485,6 +2528,8 @@ class SweepService:
         if now - self._last_books_ts >= self.books_every_s:
             self._last_books_ts = now
             self.write_books()
+        if prof is not None:
+            prof.pass_end()
         return bool(fresh or placements or progressed or persisted)
 
     def idle(self) -> bool:

@@ -51,6 +51,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from multidisttorch_tpu.telemetry import ctlprof as _ctlprof
+
 # Admission verdicts (the queue journal's ``rejected.verdict`` values).
 ADMIT = "admitted"
 REJECT_QUOTA = "rejected_quota"
@@ -414,6 +416,18 @@ class FairShareScheduler:
         """Admission decision for one more submission from ``tenant``
         given the CURRENT queue depth (the runtime calls this before
         :meth:`push`)."""
+        prof = _ctlprof.get_ctlprof()
+        if prof is not None:
+            _t = prof.t0()
+            verdict, reason = self._admit_verdict(tenant)
+            prof.note(
+                "admission", _t,
+                examined=1, mutated=1 if verdict == ADMIT else 0,
+            )
+            return verdict, reason
+        return self._admit_verdict(tenant)
+
+    def _admit_verdict(self, tenant: str) -> tuple[str, str]:
         total = self.pending_count()
         if total >= self.max_total_pending:
             return (
@@ -445,6 +459,9 @@ class FairShareScheduler:
         of EVERYTHING — it already waited (and, for a defrag victim,
         already paid). ``now`` substitutes the wall clock for the
         loadgen's virtual time."""
+        prof = _ctlprof.get_ctlprof()
+        if prof is not None:
+            _t = prof.t0()
         if self.pending_count(entry.tenant) == 0:
             # Activating from idle: start at the current virtual time.
             # Idle time must not bank credit a tenant later spends as a
@@ -459,26 +476,34 @@ class FairShareScheduler:
         entry.front_barrier = bool(front)
         if front:
             q.insert(0, entry)
+            examined = 0
         else:
-            q.insert(self._edf_index(q, entry), entry)
+            i, examined = self._edf_index(q, entry)
+            q.insert(i, entry)
         if entry.tenant not in self._rotation:
             self._rotation.append(entry.tenant)
+        if prof is not None:
+            prof.note("edf_insert", _t, examined=examined, mutated=1)
 
     @staticmethod
-    def _edf_index(q: list, entry: PendingTrial) -> int:
-        """Insertion point keeping the queue EDF-sorted: ascending
-        ``deadline_ts`` with ties FIFO, best-effort (None = +inf) kept
-        FIFO at the tail — and never ahead of a ``front_barrier``
-        entry (the front=True contract). O(n) scan from the back —
-        queues are quota-bounded and best-effort appends hit the fast
-        path."""
+    def _edf_index(q: list, entry: PendingTrial) -> tuple[int, int]:
+        """``(insertion point, entries compared)`` keeping the queue
+        EDF-sorted: ascending ``deadline_ts`` with ties FIFO,
+        best-effort (None = +inf) kept FIFO at the tail — and never
+        ahead of a ``front_barrier`` entry (the front=True contract).
+        O(n) scan from the back — queues are quota-bounded and
+        best-effort appends hit the fast path. The comparison count is
+        the insert's work-touched book (ctlprof ``edf_insert``): a
+        rebuilt heap/tree index must drive it to O(log n)."""
         d = (
             float("inf")
             if entry.deadline_ts is None
             else float(entry.deadline_ts)
         )
         i = len(q)
+        seen = 0
         while i > 0:
+            seen += 1
             prev = q[i - 1]
             if prev.front_barrier:
                 break  # front-pushed entries keep their head position
@@ -486,7 +511,7 @@ class FairShareScheduler:
             if (float("inf") if other is None else float(other)) <= d:
                 break
             i -= 1
-        return i
+        return i, seen
 
     def pending_entries(self) -> list[PendingTrial]:
         out = []
@@ -557,6 +582,7 @@ class FairShareScheduler:
         a million-submission replay stays O(1) per blocked tenant).
         """
         now = time.time() if now is None else now
+        prof = _ctlprof.get_ctlprof()
         placements: list[Placement] = []
         # One placement per (bucket, size) may sit open below max_lanes
         # at any moment of the pass — the never-split-a-bucket rule.
@@ -575,23 +601,38 @@ class FairShareScheduler:
             # served tenant's v just advanced.
             while True:
                 served = False
+                if prof is not None:
+                    _t = prof.t0()
                 # Largest free run, computed ONCE per opportunity: an
                 # entry bigger than it cannot allocate anywhere, so the
                 # scan skips it in O(1) instead of walking the free map
                 # per blocked entry (the loadgen's hot path).
                 largest = pool.largest_free_run()
-                for tenant in sorted(
+                order = sorted(
                     self._tenants_with_work(pri),
                     key=lambda t: (self._vsrv.get(t, 0.0), t),
-                ):
-                    if self._serve_one(
+                )
+                if prof is not None:
+                    # One fair-share opportunity: the free-map walk +
+                    # the vtime sort over every tenant with lane work.
+                    prof.note("fair_share_pick", _t, examined=len(order))
+                for tenant in order:
+                    if prof is not None:
+                        _t = prof.t0()
+                    got, seen = self._serve_one(
                         tenant, pri, pool, open_placements, placements,
                         max_lanes=max_lanes, now=now,
                         contended=multi_tenant_backlog,
                         can_start=can_start,
                         largest_free=largest,
                         scan_limit=scan_limit,
-                    ):
+                    )
+                    if prof is not None:
+                        prof.note(
+                            "bin_pack_scan", _t,
+                            examined=seen, mutated=1 if got else 0,
+                        )
+                    if got:
                         served = True
                         break
                 if not served:
@@ -612,16 +653,23 @@ class FairShareScheduler:
         can_start: Optional[Callable[[PendingTrial], bool]],
         largest_free: Optional[int] = None,
         scan_limit: Optional[int] = None,
-    ) -> bool:
+    ) -> tuple[bool, int]:
         """Try to place ONE trial of ``tenant`` in lane ``pri`` (EDF
         then FIFO within the lane — the queue is kept in that order by
         :meth:`push`). Scans past entries blocked on slice shape
         (stamping ``blocked_since`` — defrag's starvation clock) so one
-        large trial cannot convoy its tenant's small ones."""
+        large trial cannot convoy its tenant's small ones.
+
+        Returns ``(placed, entries examined)`` — the examined count is
+        the scan's work-touched book (ctlprof ``bin_pack_scan``):
+        queue entries looked at, including ``can_start`` vetoes and
+        shape-blocked skips, before placing one or giving up."""
         q = self._pending.get(tenant, {}).get(pri, [])
+        seen = 0
         for idx, entry in enumerate(q):
             if scan_limit is not None and idx >= scan_limit:
-                return False
+                return False, seen
+            seen = idx + 1
             # A pinned entry is a defrag victim being re-homed: it
             # already paid its cost when first placed, so its
             # re-placement advances no virtual time and is never
@@ -661,7 +709,7 @@ class FairShareScheduler:
                 q.pop(idx)
                 entry.blocked_since = None
                 self._charge(entry, contended)
-                return True
+                return True, seen
             pack_key = (entry.bucket, entry.size)
             open_p = open_placements.get(pack_key)
             attach = (
@@ -716,8 +764,8 @@ class FairShareScheduler:
             entry.blocked_since = None
             if not pinned:
                 self._charge(entry, contended)
-            return True
-        return False
+            return True, seen
+        return False, seen
 
     def _charge(self, entry: PendingTrial, contended: bool) -> None:
         """Advance the tenant's virtual time by the placement's cost.
@@ -748,12 +796,17 @@ class FairShareScheduler:
         for at most one per pass). Entries whose deadline already
         passed still sort first: they place soonest and the miss is
         accounted at settle time, never enforced by killing."""
-        out = [
-            e
-            for e in self.pending_entries()
-            if e.deadline_ts is not None
-        ]
+        prof = _ctlprof.get_ctlprof()
+        if prof is not None:
+            _t = prof.t0()
+        entries = self.pending_entries()
+        out = [e for e in entries if e.deadline_ts is not None]
         out.sort(key=lambda e: (e.deadline_ts, e.enqueue_ts))
+        if prof is not None:
+            # Candidate-list half of the preemption window search (the
+            # planner's window scan notes the same phase separately):
+            # O(pending) today — the incremental-index rebuild target.
+            prof.note("preempt_window", _t, examined=len(entries))
         return out
 
     # -- starvation ---------------------------------------------------
@@ -764,13 +817,21 @@ class FairShareScheduler:
         """Pending trials blocked on slice SHAPE for longer than the
         threshold — the defrag trigger. Ordered oldest-starved first."""
         now = time.time() if now is None else now
+        prof = _ctlprof.get_ctlprof()
+        if prof is not None:
+            _t = prof.t0()
+        entries = self.pending_entries()
         out = [
             e
-            for e in self.pending_entries()
+            for e in entries
             if e.blocked_since is not None
             and now - e.blocked_since >= threshold_s
         ]
         out.sort(key=lambda e: e.blocked_since)
+        if prof is not None:
+            # Starvation-scan half of defrag planning (the window scan
+            # in plan_defrag notes the same phase).
+            prof.note("defrag_plan", _t, examined=len(entries))
         return out
 
     # -- books --------------------------------------------------------

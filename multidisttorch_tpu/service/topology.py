@@ -50,6 +50,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from multidisttorch_tpu.service.queue import fsync_dir
+from multidisttorch_tpu.telemetry import ctlprof as _ctlprof
 
 TOPOLOGY_NAME = "topology.jsonl"
 
@@ -139,10 +140,15 @@ class Topology:
         return self.route_hash(tenant_hash(tenant))
 
     def route_hash(self, h: int) -> int:
+        prof = _ctlprof.get_ctlprof()
+        if prof is not None:
+            _t = prof.t0()
         b = h % self.n_base
         q = h // self.n_base
         # Deepest-match walk: exactly one leaf matches because leaves
         # partition each cell's suffix space (split/merge preserve it).
+        # O(leaves) per route — ctlprof's ``topo_route`` examined count
+        # is the evidence a per-base leaf index would erase.
         best: Optional[Leaf] = None
         for leaf in self.leaves.values():
             if leaf.base != b:
@@ -150,6 +156,8 @@ class Topology:
             if (q & ((1 << leaf.depth) - 1)) == leaf.bits:
                 if best is None or leaf.depth > best.depth:
                     best = leaf
+        if prof is not None:
+            prof.note("topo_route", _t, examined=len(self.leaves), mutated=1)
         if best is None:  # unreachable unless the log was corrupted
             return b
         return best.shard

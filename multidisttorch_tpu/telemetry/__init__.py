@@ -45,12 +45,14 @@ import os
 from typing import Optional
 
 from multidisttorch_tpu.telemetry import anomaly as _anomaly
+from multidisttorch_tpu.telemetry import ctlprof as _ctlprof
 from multidisttorch_tpu.telemetry import events as _events
 from multidisttorch_tpu.telemetry import metrics as _metrics
 
 get_bus = _events.get_bus
 get_registry = _metrics.get_registry
 get_monitor = _anomaly.get_monitor
+get_ctlprof = _ctlprof.get_ctlprof
 AnomalyConfig = _anomaly.AnomalyConfig
 read_events = _events.read_events
 EVENTS_NAME = _events.EVENTS_NAME
@@ -119,7 +121,20 @@ def configure(
             name = f"events.p{process_id}.jsonl"
         path = os.path.join(out_dir, name)
     _events.configure(path=path, queue_max=queue_max, host=host, world=world)
-    _metrics.configure(device_sample_every=device_sample_every)
+    reg = _metrics.configure(device_sample_every=device_sample_every)
+    # Control-plane flight books ride the same switch: the profiler's
+    # wall histograms are registry series, so the A/B overhead bench's
+    # ON side carries ctlprof and the Prometheus dump exports its
+    # books for free. Flame file (when MDT_CTLPROF_SAMPLE_HZ is set)
+    # lands next to the event stream.
+    _ctlprof.configure(
+        registry=reg,
+        flame_path=(
+            os.path.join(out_dir, "ctl_flame.txt")
+            if out_dir is not None
+            else None
+        ),
+    )
     if anomaly_capture_dir is not None:
         import dataclasses
 
@@ -136,6 +151,7 @@ def disable() -> None:
     drop bus, registry, and anomaly monitor)."""
     _anomaly.disable()
     _events.disable()
+    _ctlprof.disable()
     _metrics.disable()
 
 
@@ -187,6 +203,7 @@ __all__ = [
     "disable",
     "enabled",
     "get_bus",
+    "get_ctlprof",
     "get_monitor",
     "get_registry",
     "read_events",

@@ -567,14 +567,20 @@ def _host_pid(slot: int) -> int:
 
 
 def build_fleet_trace(
-    merged: dict, *, lineage: Optional[dict] = None
+    merged: dict,
+    *,
+    lineage: Optional[dict] = None,
+    ctl_events: Optional[list] = None,
 ) -> dict:
     """One Perfetto trace for the whole fleet: pid 1 is the supervisor
     (world-epoch spans ride its driver track), pid ``slot + 2`` is
     host ``slot`` with the usual per-trial tracks inside, and flow
     arrows connect each migrated trial's segments across worlds.
     ``lineage`` (from :func:`trial_lineage`) can be passed in to share
-    one computation with :func:`fleet_summary`."""
+    one computation with :func:`fleet_summary`. ``ctl_events`` (from
+    ``CtlProfiler.trace_events(pid=0)``) adds the control-plane track
+    as pid 0 — its timestamps are relative to its own retained pass
+    ring, a sidecar clock, not skew-corrected fleet time."""
     events = merged["events"]
     worlds = merged.get("worlds") or []
     hosts = sorted(
@@ -664,9 +670,13 @@ def build_fleet_trace(
                 }
             )
 
+    if ctl_events:
+        te.extend(ctl_events)
     te.sort(key=lambda e: (e.get("ts", -1.0), e.get("dur", 0.0)))
     trace["otherData"]["hosts"] = hosts
     trace["otherData"]["worlds"] = len(worlds)
+    if ctl_events:
+        trace["otherData"]["ctl_track"] = "pid 0 (ring-relative clock)"
     return trace
 
 
@@ -855,8 +865,22 @@ def export_fleet(
     with open(paths["events"], "w") as f:
         for ev in merged["events"]:
             f.write(json.dumps(ev, default=str) + "\n")
+    # A live control-plane profiler (this process is the daemon)
+    # contributes its pass-ring track to the exported trace.
+    from multidisttorch_tpu.telemetry import ctlprof as _ctlprof
+
+    prof = _ctlprof.get_ctlprof()
     with open(paths["trace"], "w") as f:
-        json.dump(build_fleet_trace(merged, lineage=lineage), f)
+        json.dump(
+            build_fleet_trace(
+                merged,
+                lineage=lineage,
+                ctl_events=(
+                    prof.trace_events(pid=0) if prof is not None else None
+                ),
+            ),
+            f,
+        )
     summary = fleet_summary(run_dir, merged=merged, lineage=lineage)
     with open(paths["summary"], "w") as f:
         json.dump(summary, f, indent=2, default=str)

@@ -115,6 +115,21 @@ class Histogram:
         i = self._percentile_bucket(p)
         return self.bounds[i] if i < len(self.bounds) else self.max
 
+    def percentile_bounds(self, p: float) -> tuple:
+        """Honest error bar on :meth:`percentile`: the ``(lower,
+        upper)`` edges of the bucket the ``p``-th rank falls in. The
+        true quantile lies somewhere in this closed interval; the point
+        estimate reports the upper edge, so with log-spaced bounds the
+        worst-case overstatement is the bucket ratio (one decade /
+        buckets-per-decade). For the implicit +Inf bucket the upper
+        edge is the max seen (the only finite bound available)."""
+        if self.count == 0:
+            return (0.0, 0.0)
+        i = self._percentile_bucket(p)
+        lo = self.bounds[i - 1] if i > 0 else 0.0
+        hi = self.bounds[i] if i < len(self.bounds) else self.max
+        return (lo, hi)
+
     def percentile_exemplar(self, p: float):
         """The worst-offender exemplar of the bucket the ``p``-th
         percentile falls in (or, if that bucket collected none, the
@@ -142,6 +157,14 @@ class Histogram:
             "p95_s": self.percentile(95),
             "p99_s": self.percentile(99),
             "max_s": self.max,
+            # Bucket-bound error bars: each percentile above is the
+            # UPPER edge of its bucket; the true quantile lies within
+            # [lo, hi] (docs/OBSERVABILITY.md "Honest percentiles").
+            "bucket_err": {
+                "p50_s": list(self.percentile_bounds(50)),
+                "p95_s": list(self.percentile_bounds(95)),
+                "p99_s": list(self.percentile_bounds(99)),
+            },
         }
         if self.exemplars:
             # Absent when no caller passed exemplars: pre-exemplar

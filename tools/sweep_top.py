@@ -676,6 +676,66 @@ def render_slo_panel(slo: dict) -> str:
     return head + "\n" + table
 
 
+def _fmt_ctl_s(s) -> str:
+    """Sub-millisecond-friendly duration for control-plane phase
+    times (fmt_duration floors at ms; these are often microseconds)."""
+    if s is None:
+        return "-"
+    s = float(s)
+    if s < 1e-3:
+        return f"{s * 1e6:.1f}us"
+    if s < 1.0:
+        return f"{s * 1e3:.2f}ms"
+    return f"{s:.3f}s"
+
+
+def render_ctl_panel(ctl: dict) -> str:
+    """Control-plane flight books (docs/OBSERVABILITY.md
+    "Control-plane books"): per-phase wall share, p50/p99 with the
+    histogram's bucket-bound ceiling, and work-touched accounting —
+    examined vs mutated, whose ratio exposes O(pool) scans that only
+    changed O(1) entries."""
+    passes = ctl.get("passes") or {}
+    wt = ctl.get("work_touched") or {}
+    eff = wt.get("scan_efficiency")
+    head = (
+        f"ctl  passes {passes.get('count', 0)}"
+        f"  {fmt_rate(passes.get('per_s'))}"
+        f"  pass p99 {_fmt_ctl_s(passes.get('p99_s'))}"
+        f"  examined {wt.get('examined', 0)}"
+        f"  mutated {wt.get('mutated', 0)}"
+        f"  scan-eff {f'{eff:.4f}' if eff is not None else '-'}"
+    )
+    rows = []
+    for name, b in (ctl.get("phases") or {}).items():
+        bounds = (b.get("bucket_err") or {}).get("p99_s") or (None, None)
+        p_eff = b.get("scan_efficiency")
+        rows.append(
+            [
+                name,
+                b.get("calls", 0),
+                f"{100.0 * b.get('wall_frac', 0.0):.1f}%",
+                _fmt_ctl_s(b.get("p50_s")),
+                _fmt_ctl_s(b.get("p99_s")),
+                (
+                    f"<={_fmt_ctl_s(bounds[1])}"
+                    if bounds[1] is not None
+                    else "-"
+                ),
+                b.get("examined", 0),
+                b.get("mutated", 0),
+                f"{p_eff:.4f}" if isinstance(p_eff, float) else "-",
+            ]
+        )
+    return head + "\n" + fmt_table(
+        rows,
+        [
+            "phase", "calls", "wall", "p50", "p99", "p99-bound",
+            "examined", "mutated", "eff",
+        ],
+    )
+
+
 def render_service(folded, books, state, service_dir: str) -> str:
     """Tenant/queue panel over a service directory (docs/SERVICE.md):
     queue depth by state, per-tenant goodput + fair-share vs weight,
@@ -774,6 +834,10 @@ def render_service(folded, books, state, service_dir: str) -> str:
                 f"{fmt_duration(h.get('p99_s'))}  max "
                 f"{fmt_duration(h.get('max_s'))}{ex_s}"
             )
+    ctl = books.get("ctl") or {}
+    if ctl.get("enabled"):
+        lines.append("")
+        lines.append(render_ctl_panel(ctl))
     slo = books.get("slo") or {}
     if slo.get("slos"):
         lines.append("")
