@@ -3614,7 +3614,12 @@ def _run_hpo_body(
                 start_next(g)
             except Exception as e:  # noqa: BLE001 — failure isolation
                 error_text = f"{type(e).__name__}: {e}"
-                failure_class = classify_failure(e)
+                failure_class = classify_failure(
+                    e,
+                    trial_id=(
+                        None if kind == "bucket" else run.cfg.trial_id
+                    ),
+                )
                 if kind == "bucket":
                     # Lanes already retired keep their completed
                     # results; everything in flight or queued in the

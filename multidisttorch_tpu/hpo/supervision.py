@@ -28,6 +28,7 @@ a pure function of the failure's *class*, not its text:
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 from multidisttorch_tpu.train.guards import DivergenceError
 
@@ -58,8 +59,14 @@ class UnretryableError(ValueError):
     """
 
 
-def classify_failure(exc: BaseException) -> str:
-    """Map an attempt's exception to its supervision class."""
+def classify_failure(
+    exc: BaseException, *, trial_id: Optional[int] = None
+) -> str:
+    """Map an attempt's exception to its supervision class.
+    ``trial_id``, when the caller knows it, rides on the emitted event
+    so downstream consumers (the incident plane's divergence-storm
+    counter — telemetry/incident.py) can attribute classifications to
+    distinct trials."""
     cls = _classify(exc)
     # Telemetry seam: every classification decision is an event, so a
     # chaos trace shows not just that a fault fired but what the
@@ -70,6 +77,7 @@ def classify_failure(exc: BaseException) -> str:
     if bus is not None:
         bus.emit(
             "failure_classified",
+            trial_id=trial_id,
             failure_class=cls,
             exc_type=type(exc).__name__,
             error=str(exc)[:300],

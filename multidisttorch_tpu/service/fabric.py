@@ -1128,6 +1128,17 @@ class FabricReplica:
             )
         if evidence:
             self._complete_split(shard, pend)
+            # An adopter closing a PREDECESSOR's mid-split seam is a
+            # torn split, distinct from both the normal commit and the
+            # no-op abort — the incident plane classifies on it
+            # (telemetry/incident.py: split_torn).
+            _emit(
+                "shard_split_resolved",
+                shard=int(parent),
+                child=int(child),
+                replica=self.replica,
+                action="commit",
+            )
             return
         for _ in range(8):
             won, _epoch, topo2 = stopo.append_topology_event(
@@ -1148,6 +1159,13 @@ class FabricReplica:
             child=int(child),
             replica=self.replica,
             epoch=self.topology.epoch,
+        )
+        _emit(
+            "shard_split_resolved",
+            shard=int(parent),
+            child=int(child),
+            replica=self.replica,
+            action="abort",
         )
 
     def _try_adopt(self, shard: int) -> None:

@@ -1,0 +1,566 @@
+"""Incident-plane chaos drill: every fault kind through the detector.
+
+``bench.py --incidents`` calls :func:`run_incidents_bench`. The drill
+replays one scenario per chaos fault family — the real production
+mechanism wherever one exists in-process (fabric lease takeover, torn
+split resolution, membership staleness, run_hpo fault plans, the SLO
+engine), a scripted emit at the exact production seam shape where the
+trigger is *by definition* a broken invariant no healthy code path can
+produce (a duplicate steal grant) or needs a genuinely wedged backend
+(preflight's init-deadline verdict). Each scenario runs in its own
+telemetry scope (its own event stream, flight ring, detector, and
+incident ledger), then its ``incidents.jsonl`` is folded into a
+fault -> verdict confusion matrix.
+
+Gates (bench.py enforces; docs/INCIDENTS.md is the cookbook):
+
+- **100% diagonal**: every scenario produced EXACTLY ONE incident and
+  its verdict is the expected root-cause kind. Not "at least one" —
+  the correlation/dedup/escalation machinery is the thing under test:
+  a takeover emits both the victim's ``shard_fence_lost`` and the
+  adopter's ``shard_adopted``, and two incidents would mean the plane
+  pages twice for one cause.
+- **zero false positives**: a no-fault soak (a real 2-trial sweep)
+  opens nothing.
+- **bundle present**: every fired incident published its flight-ring
+  bundle (``incidents/<id>/`` with ``trigger.json`` +
+  ``flight_ring.json``) — the black box actually dumped.
+- **taxonomy covered**: the scenarios jointly exercise all ten
+  incident kinds.
+- **autopsy agrees**: :func:`~multidisttorch_tpu.telemetry.incident.
+  build_incident_report` over the torn-split scenario re-derives the
+  same verdict offline from the durable surfaces alone.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+
+from multidisttorch_tpu import telemetry
+from multidisttorch_tpu.telemetry import incident as tincident
+from multidisttorch_tpu.telemetry.events import get_bus
+
+# Lean single-shard fabric: sub-second lease cadence so takeover /
+# adoption scenarios finish in hundreds of milliseconds, one lane and
+# a tiny dataset so adopting an empty shard never trains anything.
+_FABRIC_KW = dict(
+    n_shards=1,
+    lease_deadline_s=0.3,
+    renew_every_s=0.1,
+    adopt_scan_every_s=0.05,
+    nonpreferred_grace_s=0.0,
+    n_slices=1,
+    max_lanes=1,
+    data_rows=32,
+)
+
+# 128 rows / batch 16 = 8 optimizer steps per epoch (the chaos-test
+# geometry, tests/test_faults.py).
+_STEPS_PER_EPOCH = 8
+
+
+def _tick_until(replica, pred, timeout_s: float = 30.0) -> bool:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        replica.tick()
+        if pred():
+            return True
+        time.sleep(0.01)
+    return pred()
+
+
+def _data():
+    from multidisttorch_tpu.data.datasets import synthetic_mnist
+
+    return synthetic_mnist(128, seed=0)
+
+
+def _cfg(trial_id: int, **kw):
+    from multidisttorch_tpu.hpo.driver import TrialConfig
+
+    defaults = dict(
+        trial_id=trial_id,
+        epochs=1,
+        batch_size=16,
+        hidden_dim=32,
+        latent_dim=8,
+        log_interval=10_000,
+        seed=trial_id,
+    )
+    defaults.update(kw)
+    return TrialConfig(**defaults)
+
+
+def _sweep(configs, out_dir: str, **kw):
+    from multidisttorch_tpu.hpo.driver import run_hpo
+    from multidisttorch_tpu.hpo.supervision import RetryPolicy
+
+    base = dict(
+        num_groups=1,
+        out_dir=out_dir,
+        verbose=False,
+        save_images=False,
+        resilient=True,
+        retry=RetryPolicy(max_retries=2, backoff_base_s=0.01),
+    )
+    base.update(kw)
+    return run_hpo(configs, _data(), None, **base)
+
+
+# -- scenarios --------------------------------------------------------
+# Each returns an optional cleanup callable, run AFTER telemetry is
+# disabled (a drained replica's fence-release emits must not land in
+# the scenario's stream as fresh triggers).
+
+
+def _sc_daemon_lost(d: str):
+    """DAEMON_LOST: replica 0 claims the shard (epoch 1 — a first
+    claim, deliberately not an incident), then simply stops ticking
+    (SIGKILL semantics: the lease goes stale with no release record).
+    Replica 1 adopts at epoch 2 -> ``shard_adopted`` -> replica_lost."""
+    from multidisttorch_tpu.service import fabric
+
+    # The telemetry scope dir IS the fabric service dir: leases land
+    # under d/fabric/, the event stream and incident ledger at d — so
+    # the offline autopsy over d sees every surface of one causal
+    # chain.
+    fdir = d
+    r0 = fabric.FabricReplica(fdir, replica=0, **_FABRIC_KW)
+    assert _tick_until(r0, lambda: 0 in r0.fences), "r0 never claimed"
+    r1 = fabric.FabricReplica(fdir, replica=1, **_FABRIC_KW)
+    assert _tick_until(r1, lambda: r1.adoptions >= 1), "r1 never adopted"
+    return lambda: (_quiet_stop(r0), _quiet_stop(r1))
+
+
+def _sc_fence_raced(d: str):
+    """Fence loss seen from the VICTIM: an out-of-band epoch-2 claim
+    outbids replica 0's lease; its next renew discovers the higher
+    epoch and drops -> ``shard_fence_lost`` -> fence_lost."""
+    from multidisttorch_tpu.service import fabric
+
+    # The telemetry scope dir IS the fabric service dir: leases land
+    # under d/fabric/, the event stream and incident ledger at d — so
+    # the offline autopsy over d sees every surface of one causal
+    # chain.
+    fdir = d
+    r0 = fabric.FabricReplica(fdir, replica=0, **_FABRIC_KW)
+    assert _tick_until(r0, lambda: 0 in r0.fences), "r0 never claimed"
+    fence = fabric.try_claim(fdir, 0, 9)
+    assert fence is not None, "out-of-band claim lost the race"
+    assert _tick_until(r0, lambda: r0.fences_lost >= 1), "fence not lost"
+    return lambda: _quiet_stop(r0)
+
+
+def _sc_wedge(d: str):
+    """WEDGE: a deadline-bounded collective abandons its watchdog ->
+    ``WedgedCollective`` reaches the supervision seam. (In a bench
+    process ``jax.process_count() == 1`` and ``call_with_timeout``
+    short-circuits without a watchdog, so the drill raises the
+    production exception type at the production classification seam
+    rather than wedging a real peer.)"""
+    from multidisttorch_tpu.hpo.supervision import classify_failure
+    from multidisttorch_tpu.parallel.cluster import WedgedCollective
+
+    exc = WedgedCollective(
+        "collective 'epoch_loss' wedged past 30.0s deadline"
+    )
+    assert classify_failure(exc, trial_id=0) == "preemption"
+
+
+def _sc_shard_split_lost(d: str):
+    """SHARD_SPLIT_LOST: replica 0 claims, durably begins a split
+    (SPLIT_BEGIN in the topology log), and dies before commit. The
+    adopter opens replica_lost on the takeover, then resolves the
+    predecessor's seam -> ``shard_split_resolved`` ESCALATES the same
+    incident to the more specific split_torn verdict."""
+    from multidisttorch_tpu.service import fabric
+    from multidisttorch_tpu.service import topology as stopo
+
+    # The telemetry scope dir IS the fabric service dir: leases land
+    # under d/fabric/, the event stream and incident ledger at d — so
+    # the offline autopsy over d sees every surface of one causal
+    # chain.
+    fdir = d
+    r0 = fabric.FabricReplica(fdir, replica=0, **_FABRIC_KW)
+    assert _tick_until(r0, lambda: 0 in r0.fences), "r0 never claimed"
+    topo = stopo.load_topology(fdir, n_base=1)
+    won, _epoch, _topo = stopo.append_topology_event(
+        fdir,
+        {
+            "event": stopo.SPLIT_BEGIN,
+            "parent": 0,
+            "child": topo.next_shard_id(),
+            "replica": 0,
+        },
+    )
+    assert won, "SPLIT_BEGIN lost the topology race"
+    r1 = fabric.FabricReplica(fdir, replica=1, **_FABRIC_KW)
+    det = telemetry.get_incident_detector()
+    assert _tick_until(
+        r1,
+        lambda: any(
+            i.kind == tincident.SPLIT_TORN for i in det.open_incidents()
+        ),
+    ), "torn split never escalated"
+    return lambda: (_quiet_stop(r0), _quiet_stop(r1))
+
+
+def _sc_backend_wedge(d: str):
+    """Backend wedge: the preflight verdict seam, production field
+    shape (utils/preflight.py emits exactly this on an init-deadline
+    expiry; actually wedging a backend needs a dead chip)."""
+    from multidisttorch_tpu.utils import preflight
+
+    get_bus().emit(
+        "preflight_verdict",
+        platform="cpu",
+        verdict=preflight.WEDGED_INIT_TIMEOUT,
+        reason="drill: init blocked past deadline, no live holder",
+        usable=False,
+        elapsed_s=12.0,
+    )
+
+
+def _sc_slo_overload(d: str):
+    """SLO burn: a real SloEngine over a breaching latency stream,
+    with the exemplar histogram attached — the firing ``slo_alert``
+    must carry the p99 worst-offender id into the incident detail."""
+    from multidisttorch_tpu.service.runtime import LATENCY_BUCKETS
+    from multidisttorch_tpu.telemetry.metrics import Histogram
+    from multidisttorch_tpu.telemetry.slo import LATENCY, SloEngine, SloSpec
+
+    eng = SloEngine(
+        (
+            SloSpec(
+                name="drill_queue_wait",
+                kind=LATENCY,
+                source="queue_wait",
+                threshold_s=0.1,
+                objective=0.9,
+                windows=((5.0, 1.0),),
+            ),
+        )
+    )
+    hist = Histogram(LATENCY_BUCKETS)
+    eng.attach_exemplar("queue_wait", hist)
+    t = time.time()
+    for i in range(20):
+        hist.observe(3.0, exemplar=f"drill-sub-{i:04d}")
+        eng.observe_latency("queue_wait", 3.0, ts=t + i * 0.1)
+    eng.evaluate(now=t + 2.5)
+
+
+def _sc_diverge_storm(d: str):
+    """DIVERGE x3: three distinct trials poisoned in one sweep. Each
+    single divergence is routine attrition (no incident); the third
+    distinct trial inside the storm window opens divergence_storm."""
+    from multidisttorch_tpu.faults import DIVERGE, FaultPlan, FaultSpec
+
+    plan = FaultPlan(
+        specs=tuple(FaultSpec(DIVERGE, t, step=2) for t in range(3))
+    )
+    results = _sweep(
+        [_cfg(t) for t in range(3)],
+        os.path.join(d, "sweep"),
+        fault_plan=plan,
+    )
+    assert all(r.status == "diverged" for r in results)
+
+
+def _sc_ckpt_corrupt(d: str):
+    """CKPT_CORRUPT + CRASH: the only checkpoint rots, the crash-retry
+    scan rejects it (CRC) -> ``ckpt_scan_reject`` -> ckpt_integrity.
+    Repeated rejects of the same store dedup into one incident."""
+    from multidisttorch_tpu.faults import (
+        CKPT_CORRUPT,
+        CRASH,
+        FaultPlan,
+        FaultSpec,
+    )
+
+    plan = FaultPlan(
+        specs=(
+            FaultSpec(CKPT_CORRUPT, 0, epoch=1),
+            FaultSpec(CRASH, 0, step=_STEPS_PER_EPOCH + 3),
+        )
+    )
+    (r,) = _sweep(
+        [_cfg(0, epochs=2)], os.path.join(d, "sweep"), fault_plan=plan
+    )
+    assert r.status == "completed"
+
+
+def _sc_preempt(d: str):
+    """PREEMPT: HostPreemption escapes run_hpo even under resilient
+    mode (per-trial retry on a dying host is meaningless) — but the
+    classification event fires first -> host_preempted."""
+    from multidisttorch_tpu.faults import (
+        PREEMPT,
+        FaultPlan,
+        FaultSpec,
+        HostPreemption,
+    )
+
+    plan = FaultPlan(specs=(FaultSpec(PREEMPT, 0, step=2),))
+    try:
+        _sweep([_cfg(0)], os.path.join(d, "sweep"), fault_plan=plan)
+    except HostPreemption:
+        return
+    raise AssertionError("PREEMPT fault did not propagate")
+
+
+def _sc_host_lost(d: str):
+    """HOST_LOST: a membership heartbeat dies dirty (thread killed
+    without the clean ``left`` record); the view's staleness check
+    emits ``host_lost`` on the transition -> replica_lost(host:slot)."""
+    from multidisttorch_tpu.parallel import membership
+
+    rdir = os.path.join(d, "run")
+    hb = membership.Heartbeat(rdir, 0, interval_s=0.05)
+    hb.start()
+    time.sleep(0.15)
+    # SIGKILL semantics: stop the loop WITHOUT Heartbeat.stop() — a
+    # clean exit writes "left" and is deliberately never lost.
+    hb._stop.set()
+    if hb._thread is not None:
+        hb._thread.join(timeout=5.0)
+    view = membership.MembershipView(rdir)
+    lost = view.lost_hosts(0.05, now=time.time() + 1.0)
+    assert lost == [0], f"expected slot 0 lost, got {lost}"
+
+
+def _sc_steal_dup_grant(d: str):
+    """Duplicate steal grant: two incarnations both answered request
+    seq 7 — fencing failed. No healthy code path can produce this
+    (the steal file is append-only and grants are keyed by seq), so
+    the drill scripts the second grant at the production emit shape
+    (service/fabric.py ``steal_grant``)."""
+    bus = get_bus()
+    for epoch in (3, 4):
+        bus.emit(
+            "steal_grant",
+            victim_shard=0,
+            thief_shard=1,
+            replica=epoch - 3,
+            seq=7,
+            n=2,
+        )
+
+
+def _sc_soak(d: str):
+    """No faults at all: a real 2-trial sweep. Gate: ZERO incidents."""
+    results = _sweep([_cfg(0), _cfg(1)], os.path.join(d, "sweep"))
+    assert all(r.status == "completed" for r in results)
+
+
+def _quiet_stop(replica) -> None:
+    with contextlib.suppress(Exception):
+        replica.stop()
+
+
+# name, fault label (faults/plan.py vocabulary where the kind exists
+# there), expected verdict, scenario fn, scripted-seam flag.
+_SCENARIOS = (
+    ("daemon_lost", "daemon_lost", tincident.REPLICA_LOST,
+     _sc_daemon_lost, False),
+    ("fence_raced", "fence_raced", tincident.FENCE_LOST,
+     _sc_fence_raced, False),
+    ("wedge", "wedge", tincident.WEDGED_COLLECTIVE, _sc_wedge, False),
+    ("shard_split_lost", "shard_split_lost", tincident.SPLIT_TORN,
+     _sc_shard_split_lost, False),
+    ("backend_wedge", "backend_wedge", tincident.BACKEND_WEDGED,
+     _sc_backend_wedge, True),
+    ("slo_overload", "slo_overload", tincident.SLO_BURN,
+     _sc_slo_overload, False),
+    ("diverge_storm", "diverge", tincident.DIVERGENCE_STORM,
+     _sc_diverge_storm, False),
+    ("ckpt_corrupt", "ckpt_corrupt", tincident.CKPT_INTEGRITY,
+     _sc_ckpt_corrupt, False),
+    ("preempt", "preempt", tincident.HOST_PREEMPTED, _sc_preempt, False),
+    ("host_lost", "host_lost", tincident.REPLICA_LOST,
+     _sc_host_lost, False),
+    ("steal_dup_grant", "steal_dup_grant", tincident.STEAL_ANOMALY,
+     _sc_steal_dup_grant, True),
+)
+
+
+def _bundle_check(scope_dir: str, inc: dict):
+    """The incident's published bundle dir, and whether it holds the
+    black-box minimum (trigger + flight-ring dump)."""
+    bdir = os.path.join(scope_dir, tincident.BUNDLE_DIRNAME, inc["id"])
+    ok = all(
+        os.path.isfile(os.path.join(bdir, n))
+        for n in ("trigger.json", "flight_ring.json")
+    )
+    return (bdir if os.path.isdir(bdir) else None), ok
+
+
+def _run_scenario(root: str, name: str, expected: str, fn) -> dict:
+    d = os.path.join(root, name)
+    os.makedirs(d, exist_ok=True)
+    t0 = time.perf_counter()
+    telemetry.configure(d)
+    cleanup, error = None, None
+    try:
+        cleanup = fn(d)
+    except Exception as e:  # noqa: BLE001 — the gate reports, not raises
+        error = f"{type(e).__name__}: {e}"
+    finally:
+        ring = telemetry.get_flight_ring()
+        ring_stats = (
+            {"noted": ring.noted, "held": len(ring.snapshot()),
+             "maxlen": ring.maxlen}
+            if ring is not None
+            else None
+        )
+        telemetry.disable()
+        if callable(cleanup):
+            with contextlib.suppress(Exception):
+                cleanup()
+    folded = tincident.load_incidents(d)
+    incs = sorted(folded.values(), key=lambda i: str(i.get("id")))
+    bundle, bundle_ok = (None, False)
+    if len(incs) == 1:
+        bundle, bundle_ok = _bundle_check(d, incs[0])
+    verdict = incs[0]["kind"] if len(incs) == 1 else None
+    return {
+        "expected": expected,
+        "n_incidents": len(incs),
+        "verdict": verdict,
+        "incidents": [
+            {
+                "id": i.get("id"),
+                "kind": i.get("kind"),
+                "subject": i.get("subject"),
+                "count": i.get("count"),
+                "status": i.get("status"),
+            }
+            for i in incs
+        ],
+        "bundle": bundle,
+        "bundle_ok": bundle_ok,
+        "flight_ring": ring_stats,
+        "error": error,
+        "elapsed_s": round(time.perf_counter() - t0, 3),
+        "scope_dir": d,
+        "ok": error is None
+        and len(incs) == 1
+        and verdict == expected
+        and bundle_ok,
+    }
+
+
+def _autopsy(scenarios: dict) -> dict:
+    """Offline causal autopsy over the torn-split scenario: the report
+    must re-derive the SAME verdict from the durable surfaces alone
+    (lease stream, topology log, event shards, flight-ring dump)."""
+    sc = scenarios.get("shard_split_lost") or {}
+    if not sc.get("incidents"):
+        return {"ok": False, "error": "no incident to autopsy"}
+    iid = sc["incidents"][0]["id"]
+    try:
+        report = tincident.build_incident_report(sc["scope_dir"], iid)
+    except Exception as e:  # noqa: BLE001
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+    out_dir = report.get("bundle_dir") or sc.get("bundle")
+    files_ok = bool(out_dir) and all(
+        os.path.isfile(os.path.join(out_dir, n))
+        for n in ("report.json", "perfetto.json", "affected_traces.json")
+    )
+    return {
+        "incident": iid,
+        "verdict": report.get("verdict"),
+        "corroborating_surfaces": report.get("corroborating_surfaces"),
+        "timeline_records": len(report.get("timeline") or ()),
+        "report_dir": out_dir,
+        "files_ok": files_ok,
+        "ok": report.get("verdict") == tincident.SPLIT_TORN and files_ok,
+    }
+
+
+def run_incidents_bench(work_dir: str) -> dict:
+    """Replay every chaos fault family, fold the fault -> verdict
+    confusion matrix, and gate it (module docstring). Returns the
+    artifact dict; ``ok`` is the CI verdict."""
+    os.makedirs(work_dir, exist_ok=True)
+    scenarios: dict = {}
+    confusion: dict = {}
+    for name, fault, expected, fn, scripted in _SCENARIOS:
+        print(f"[incidents] scenario {name} ...", flush=True)
+        sc = _run_scenario(work_dir, name, expected, fn)
+        sc["fault"] = fault
+        sc["scripted_seam"] = scripted
+        scenarios[name] = sc
+        row = confusion.setdefault(fault, {})
+        for inc in sc["incidents"]:
+            row[inc["kind"]] = row.get(inc["kind"], 0) + 1
+        print(
+            f"[incidents]   -> {sc['n_incidents']} incident(s), "
+            f"verdict={sc['verdict']} expected={expected} "
+            f"ok={sc['ok']}"
+            + (f" error={sc['error']}" if sc["error"] else ""),
+            flush=True,
+        )
+
+    print("[incidents] no-fault soak ...", flush=True)
+    soak = _run_scenario(work_dir, "soak", None, _sc_soak)
+    soak["ok"] = soak["error"] is None and soak["n_incidents"] == 0
+    print(
+        f"[incidents]   -> {soak['n_incidents']} incident(s) "
+        f"(gate: 0) ok={soak['ok']}",
+        flush=True,
+    )
+
+    autopsy = _autopsy(scenarios)
+    covered = {
+        sc["verdict"] for sc in scenarios.values() if sc["verdict"]
+    }
+    slo_detail = next(
+        (
+            i
+            for sc in scenarios.values()
+            for i in sc["incidents"]
+            if i["kind"] == tincident.SLO_BURN
+        ),
+        None,
+    )
+    exemplar_ok = False
+    if slo_detail is not None:
+        folded = tincident.load_incidents(
+            scenarios["slo_overload"]["scope_dir"]
+        )
+        detail = (folded.get(slo_detail["id"]) or {}).get("detail") or {}
+        exemplar_ok = bool((detail.get("exemplar") or {}).get("id"))
+
+    gates = {
+        "diagonal_ok": all(sc["ok"] for sc in scenarios.values()),
+        "soak_zero_false_positives": soak["ok"],
+        "bundles_ok": all(sc["bundle_ok"] for sc in scenarios.values()),
+        "taxonomy_covered": sorted(covered) == sorted(tincident.KINDS),
+        "autopsy_ok": autopsy["ok"],
+        "slo_exemplar_cited": exemplar_ok,
+    }
+    return {
+        "protocol": "incidents_v1",
+        "scenarios": scenarios,
+        "confusion": confusion,
+        "soak": soak,
+        "autopsy": autopsy,
+        "taxonomy": sorted(tincident.KINDS),
+        "taxonomy_hit": sorted(covered),
+        "gates": gates,
+        "ok": all(gates.values()),
+    }
+
+
+if __name__ == "__main__":
+    import sys
+    import tempfile
+
+    r = run_incidents_bench(tempfile.mkdtemp(prefix="bench_incidents_"))
+    json.dump(r, sys.stdout, indent=1, default=str)
+    print()
+    sys.exit(0 if r["ok"] else 1)

@@ -512,6 +512,12 @@ class SweepService:
 
         self.queue_wait = Histogram(LATENCY_BUCKETS)
         self.placement_latency = Histogram(LATENCY_BUCKETS)
+        # Firing slo_alert events cite the burning histogram's p99
+        # worst-offender submission id (percentile_exemplar) — the
+        # alert-to-trace jump (ISSUE 19). The observe seams below pass
+        # exemplar=sub_id into these same books.
+        self.slo.attach_exemplar("queue_wait", self.queue_wait)
+        self.slo.attach_exemplar("placement_latency", self.placement_latency)
         # Drain-phase books: snapshot = drain call → slices freed;
         # persist = drain call → the victim's checkpoint durably on
         # disk (the ledger-record moment). The gap between the two is
@@ -1586,7 +1592,12 @@ class SweepService:
 
     def _placement_failed(self, ap: _Active, exc: BaseException) -> None:
         error_text = f"{type(exc).__name__}: {exc}"
-        fclass = classify_failure(exc)
+        fclass = classify_failure(
+            exc,
+            trial_id=(
+                next(iter(ap.entries)) if len(ap.entries) == 1 else None
+            ),
+        )
         self._retire(ap)
         if not ap.stacked:
             try:

@@ -47,15 +47,19 @@ from typing import Optional
 from multidisttorch_tpu.telemetry import anomaly as _anomaly
 from multidisttorch_tpu.telemetry import ctlprof as _ctlprof
 from multidisttorch_tpu.telemetry import events as _events
+from multidisttorch_tpu.telemetry import incident as _incident
 from multidisttorch_tpu.telemetry import metrics as _metrics
 
 get_bus = _events.get_bus
 get_registry = _metrics.get_registry
 get_monitor = _anomaly.get_monitor
 get_ctlprof = _ctlprof.get_ctlprof
+get_flight_ring = _incident.get_flight_ring
+get_incident_detector = _incident.get_detector
 AnomalyConfig = _anomaly.AnomalyConfig
 read_events = _events.read_events
 EVENTS_NAME = _events.EVENTS_NAME
+INCIDENTS_NAME = _incident.INCIDENTS_NAME
 
 
 def enabled() -> bool:
@@ -120,7 +124,16 @@ def configure(
         if num_processes > 1:
             name = f"events.p{process_id}.jsonl"
         path = os.path.join(out_dir, name)
-    _events.configure(path=path, queue_max=queue_max, host=host, world=world)
+    bus = _events.configure(
+        path=path, queue_max=queue_max, host=host, world=world
+    )
+    # Incident plane rides the same switch (ISSUE 19): the always-on
+    # flight ring + root-cause detector tap every emit, the incident
+    # ledger and bundles land next to the event stream, and the
+    # standing <=2% A/B therefore measures the ON side with the ring
+    # armed. The tap is installed AFTER the detector exists so no emit
+    # ever sees a half-armed plane.
+    bus.tap = _incident.configure(out_dir, host=bus.host)
     reg = _metrics.configure(device_sample_every=device_sample_every)
     # Control-plane flight books ride the same switch: the profiler's
     # wall histograms are registry series, so the A/B overhead bench's
@@ -148,9 +161,11 @@ def configure(
 
 def disable() -> None:
     """Turn telemetry OFF (close the sink, stop any profiler window,
-    drop bus, registry, and anomaly monitor)."""
+    drop bus, registry, anomaly monitor, flight ring, and incident
+    detector)."""
     _anomaly.disable()
     _events.disable()
+    _incident.disable()
     _ctlprof.disable()
     _metrics.disable()
 
@@ -197,6 +212,7 @@ def telemetry_run(out_dir: Optional[str] = None, **kwargs):
 
 __all__ = [
     "EVENTS_NAME",
+    "INCIDENTS_NAME",
     "AnomalyConfig",
     "configure",
     "configure_from_env",
@@ -204,6 +220,8 @@ __all__ = [
     "enabled",
     "get_bus",
     "get_ctlprof",
+    "get_flight_ring",
+    "get_incident_detector",
     "get_monitor",
     "get_registry",
     "read_events",

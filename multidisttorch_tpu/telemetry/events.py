@@ -122,6 +122,12 @@ class Bus:
         self.world = world
         self.dropped = 0
         self.emitted = 0
+        # Optional per-emit observer (the incident plane's flight ring
+        # + detector — telemetry/incident.py). Called OUTSIDE the emit
+        # lock with the event's serialized dict, so a tap that itself
+        # emits (the detector's `incident` events) re-enters cleanly.
+        # None when unarmed: the off path is one attribute read.
+        self.tap = None
         self._recent: deque[Event] = deque()
         self._lock = threading.Lock()
         self._sink: Optional[IO[str]] = None
@@ -147,7 +153,9 @@ class Bus:
         **data,
     ) -> Event:
         """Record one event: append to the bounded ring (drop-oldest on
-        overflow) and to the JSONL sink (flushed, not fsync'd)."""
+        overflow) and to the JSONL sink (flushed, not fsync'd), then
+        hand the serialized dict to the tap (if armed)."""
+        rec = None
         with self._lock:
             # Timestamp INSIDE the lock: emitters race (the driver loop
             # vs the background checkpoint writer), and stamping before
@@ -170,11 +178,11 @@ class Bus:
                 self._recent.popleft()
                 self.dropped += 1
             self._recent.append(ev)
+            if self._sink is not None or self.tap is not None:
+                rec = ev.to_dict()
             if self._sink is not None:
                 try:
-                    self._sink.write(
-                        json.dumps(ev.to_dict(), default=str) + "\n"
-                    )
+                    self._sink.write(json.dumps(rec, default=str) + "\n")
                     self._sink.flush()
                 except (OSError, ValueError):
                     # Observability must never kill the sweep: a full
@@ -185,6 +193,12 @@ class Bus:
                     except (OSError, ValueError):
                         pass
                     self._sink = None
+        tap = self.tap
+        if tap is not None and rec is not None:
+            try:
+                tap(rec)
+            except Exception:  # noqa: BLE001 — a tap never kills emit
+                pass
         return ev
 
     def recent(self) -> list[Event]:

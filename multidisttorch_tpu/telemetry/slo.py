@@ -231,6 +231,13 @@ class SloEngine:
         )
         self._trackers: dict[tuple, _Tracker] = {}
         self._by_source: dict[str, list[SloSpec]] = {}
+        # source -> metrics.Histogram carrying per-bucket worst-offender
+        # exemplars (attach_exemplar): a FIRING slo_alert then names the
+        # p99 bucket's worst trace id, so the alert links straight to
+        # the submission behind the burn (sweep_trace --worst jumps
+        # there). Nothing attached => the field is never serialized —
+        # pre-exemplar streams stay byte-identical.
+        self._exemplar_sources: dict[str, object] = {}
         for s in self.specs:
             self._by_source.setdefault(s.source, []).append(s)
 
@@ -243,6 +250,13 @@ class SloEngine:
 
     def watches(self, source: str) -> bool:
         return source in self._by_source
+
+    def attach_exemplar(self, source: str, histogram) -> None:
+        """Register the exemplar-carrying ``metrics.Histogram`` behind
+        ``source``'s latency observations (the service attaches its
+        ``queue_wait`` / ``placement_latency`` books). Firing alerts
+        on that source then cite ``percentile_exemplar(99)``."""
+        self._exemplar_sources[source] = histogram
 
     def observe_latency(
         self, source: str, value_s: float, *, ts: Optional[float] = None
@@ -299,6 +313,18 @@ class SloEngine:
                 tracker.alerting = ev["alerting"]
                 state = "firing" if ev["alerting"] else "resolved"
                 if bus is not None:
+                    extra = {}
+                    if state == "firing":
+                        h = self._exemplar_sources.get(
+                            tracker.spec.source
+                        )
+                        if h is not None:
+                            try:
+                                ex = h.percentile_exemplar(99)
+                            except Exception:  # noqa: BLE001
+                                ex = None
+                            if ex is not None:
+                                extra["exemplar"] = ex
                     bus.emit(
                         "slo_alert",
                         slo=name,
@@ -308,6 +334,7 @@ class SloEngine:
                         burn={
                             w: b["burn"] for w, b in ev["burn"].items()
                         },
+                        **extra,
                     )
             if ev["alerting"]:
                 alerts.append(

@@ -540,6 +540,11 @@ class ServiceFollow:
         self.qoffset = 0
         self.state = SweepFold()
         self.eoffset = 0
+        # Incident ledger fold (telemetry/incident.py): same persistent
+        # byte-offset discipline — the ledger is append-only, so new
+        # complete lines replay onto the standing fold.
+        self.ifold: dict = {}
+        self.ioffset = 0
 
     def _guard_shrink(self, path: str, offset: int, reset) -> int:
         try:
@@ -579,7 +584,18 @@ class ServiceFollow:
                 epath, self.eoffset, reset_state
             )
             self.eoffset = follow_lines(epath, self.state, self.eoffset)
-        return self.qfold, books, self.state
+        from multidisttorch_tpu.telemetry import incident as tincident
+
+        ipath = os.path.join(
+            self.service_dir, "telemetry", tincident.INCIDENTS_NAME
+        )
+        self.ioffset = self._guard_shrink(
+            ipath, self.ioffset, self.ifold.clear
+        )
+        irecs, self.ioffset = read_jsonl_from(ipath, self.ioffset)
+        if irecs:
+            tincident.fold_incidents_into(self.ifold, irecs)
+        return self.qfold, books, self.state, self.ifold
 
 
 def service_state(service_dir: str):
@@ -736,7 +752,70 @@ def render_ctl_panel(ctl: dict) -> str:
     )
 
 
-def render_service(folded, books, state, service_dir: str) -> str:
+def render_incidents_panel(incidents: dict) -> str:
+    """Root-cause scoreboard over the service's incident ledger
+    (docs/INCIDENTS.md): open incidents first (newest activity on
+    top), then the most recently resolved — verdict, subject, dedup
+    count, flap count, age, and the trials cited in the evidence."""
+    if not incidents:
+        return ""
+    now = time.time()
+
+    def age(inc):
+        ts = inc.get("last_ts")
+        return fmt_duration(now - float(ts)) if ts else "-"
+
+    def affected(inc):
+        tids = sorted(
+            {
+                ev.get("trial_id")
+                for ev in (inc.get("evidence") or ())
+                if isinstance(ev, dict) and ev.get("trial_id") is not None
+            },
+            key=str,
+        )
+        if not tids:
+            return "-"
+        cell = ",".join(str(t) for t in tids[:4])
+        return cell + ("…" if len(tids) > 4 else "")
+
+    opens = [
+        i for i in incidents.values() if i.get("status") == "open"
+    ]
+    closed = [
+        i for i in incidents.values() if i.get("status") != "open"
+    ]
+    opens.sort(key=lambda i: -(i.get("last_ts") or 0.0))
+    closed.sort(key=lambda i: -(i.get("last_ts") or 0.0))
+    rows = []
+    for inc in (opens + closed)[:8]:
+        rows.append(
+            [
+                str(inc.get("id")),
+                str(inc.get("kind")),
+                str(inc.get("subject")),
+                str(inc.get("status")),
+                inc.get("count", 1),
+                inc.get("flaps", 0),
+                age(inc),
+                affected(inc),
+            ]
+        )
+    lines = [
+        f"incidents  open {len(opens)}  resolved {len(closed)}",
+        fmt_table(
+            rows,
+            ["incident", "verdict", "subject", "status", "count",
+             "flaps", "age", "trials"],
+        ),
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def render_service(
+    folded, books, state, service_dir: str, incidents=None
+) -> str:
     """Tenant/queue panel over a service directory (docs/SERVICE.md):
     queue depth by state, per-tenant goodput + fair-share vs weight,
     scheduling-latency books, the fragmentation gauge, defrag +
@@ -916,6 +995,8 @@ def render_service(folded, books, state, service_dir: str) -> str:
             )
         )
         lines.append("")
+    if incidents:
+        lines.append(render_incidents_panel(incidents))
     if state.trials:
         lines.append(render(state, service_dir))
     return "\n".join(lines)
@@ -998,9 +1079,13 @@ def main(argv=None) -> int:
                 parts.append(
                     fabric_panel(args.path, deadline_s=args.deadline)
                 )
-            for k, (folded, books, state) in states.items():
+            for k, (folded, books, state, incidents) in states.items():
                 d = shard_dirs[k]
-                parts.append(render_service(folded, books, state, d))
+                parts.append(
+                    render_service(
+                        folded, books, state, d, incidents=incidents
+                    )
+                )
             return "\n".join(parts)
 
         def service_shot():
@@ -1016,10 +1101,13 @@ def main(argv=None) -> int:
                     snap["fabric"] = _fabric.fabric_health(
                         args.path, lease_deadline_s=args.deadline
                     )
-                for k, (folded, books, state) in states.items():
+                for k, (
+                    folded, books, state, incidents
+                ) in states.items():
                     snap["shards"][str(k) if k is not None else "_"] = {
                         "queue": folded,
                         "books": books,
+                        "incidents": incidents,
                         "trials": {
                             t: state.trials[t]
                             for t in sorted(state.trials)
